@@ -1,0 +1,113 @@
+"""Benchmark harness — emits ONE JSON line with the canonical metric.
+
+Measures the reference's canonical metric (SURVEY.md §6): ``Total
+images/sec`` for ResNet50 training on seeded synthetic ImageNet-shaped
+data (the reference's ``FAKE=True`` IO-free upper-bound protocol,
+``01_CreateResources.ipynb`` cell 2), on whatever devices are attached —
+one v5e chip under the driver, 8 forced CPU devices in dev.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+comparison point is the reference-era per-GPU estimate for its exact stack
+(ResNet50 fp32, per-GPU batch 64, Horovod/V100): ~325 images/sec/GPU.
+``vs_baseline`` = our images/sec *per chip* / 325.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+REFERENCE_IMAGES_PER_SEC_PER_DEVICE = 325.0  # V100 fp32 ResNet50, reference stack
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def run_bench(per_device_batch: int):
+    import jax.numpy as jnp
+    import optax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models.resnet import ResNet
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+    from distributeddeeplearning_tpu.training import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+    from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+    n_dev = jax.device_count()
+    global_batch = per_device_batch * n_dev
+    cfg = TrainConfig(batch_size_per_device=per_device_batch)
+    model = ResNet(depth=50, num_classes=1000, dtype=jnp.bfloat16)
+    mesh = data_parallel_mesh()
+    tx, _ = create_optimizer(cfg, steps_per_epoch=cfg.steps_per_epoch())
+    state = replicate_state(create_train_state(model, cfg, tx), mesh)
+    step = make_train_step(model, tx, mesh, cfg)
+
+    rng = np.random.RandomState(42)
+    host_batch = (
+        rng.uniform(-1, 1, size=(global_batch, 224, 224, 3)).astype(np.float32),
+        rng.randint(0, 1000, size=(global_batch,)).astype(np.int32),
+    )
+    batch = shard_batch(host_batch, mesh)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # host readback: drains the device queue
+
+    # Fence with a host readback of a value that depends on every step in
+    # the chain — block_until_ready alone does not reliably wait through
+    # the axon loopback relay (it reported 165x hardware peak).
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    images_per_sec = MEASURE_STEPS * global_batch / dt
+    return images_per_sec, n_dev
+
+
+def main():
+    last_err = None
+    for per_device_batch in (256, 128, 64, 32):
+        try:
+            ips, n_dev = run_bench(per_device_batch)
+            per_chip = ips / n_dev
+            print(
+                json.dumps(
+                    {
+                        "metric": "resnet50_synthetic_train_images_per_sec",
+                        "value": round(ips, 1),
+                        "unit": "images/sec",
+                        "vs_baseline": round(
+                            per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3
+                        ),
+                        "detail": {
+                            "devices": n_dev,
+                            "per_device_batch": per_device_batch,
+                            "images_per_sec_per_device": round(per_chip, 1),
+                            "platform": jax.devices()[0].platform,
+                            "baseline_images_per_sec_per_device": REFERENCE_IMAGES_PER_SEC_PER_DEVICE,
+                        },
+                    }
+                )
+            )
+            return 0
+        except Exception as e:  # OOM etc. → retry smaller batch
+            last_err = e
+            continue
+    print(json.dumps({"metric": "resnet50_synthetic_train_images_per_sec",
+                      "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+                      "error": repr(last_err)}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

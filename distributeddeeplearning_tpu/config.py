@@ -1,0 +1,148 @@
+"""Typed training configuration with reference env-var compatibility.
+
+The reference configures its trainers entirely through env vars parsed ad
+hoc in each script (``DISTRIBUTED``, ``FAKE``, ``FAKE_DATA_LENGTH``,
+``EPOCHS``, ``VALIDATION`` plus Keras-only worker knobs — SURVEY.md §5
+"Config / flag system"; ``imagenet_estimator_tf_horovod.py:36-48``) and
+module constants (``_LR = 0.001``, ``_BATCHSIZE = 64``, ``:24-33``). Here
+configuration is a typed dataclass with an env-var compatibility
+constructor so the reference's operational contract (same script local and
+on-cluster, configured by the launcher via env) still works.
+
+Reference defects fixed (SURVEY.md §2c):
+- #2: ``EPOCHS`` env var returned ``str`` and broke arithmetic — all
+  numeric env vars are parsed to int/float here.
+- permissive ``_str_to_bool`` (``"t" in value.lower()``, so "false" →
+  True-ish behavior on words containing t) replaced by an explicit set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional, Sequence, Tuple
+
+# ImageNet preprocessing constants, matching the reference exactly:
+# per-channel means (imagenet_estimator_tf_horovod.py:30-32) and the
+# torchvision mean/sd pair (imagenet_pytorch_horovod.py:41-42).
+IMAGENET_RGB_MEAN_255 = (123.68, 116.78, 103.94)
+IMAGENET_RGB_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_RGB_SD = (0.229, 0.224, 0.225)
+IMAGENET_TRAIN_LENGTH = 1_281_167  # FAKE_DATA_LENGTH default, TF :45-47
+
+
+def _str_to_bool(value: str) -> bool:
+    """Strict boolean env parsing (fixes the reference's ``"t" in v`` rule)."""
+    return value.strip().lower() in {"1", "true", "t", "yes", "y", "on"}
+
+
+def _env(env: Optional[Mapping[str, str]]) -> Mapping[str, str]:
+    return os.environ if env is None else env
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Everything a training run needs, in one typed object."""
+
+    # Model / task
+    model: str = "resnet50"
+    num_classes: int = 1000
+    image_size: int = 224
+    compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+
+    # Optimization — reference constants: LR 0.001 × world size
+    # (TF :154, PyTorch :333), momentum 0.9, L2 5e-5 (Keras :97-116),
+    # warmup 5 epochs + ×0.1 decay @30/60/80 (Keras :211-224, arXiv:1706.02677).
+    batch_size_per_device: int = 64
+    base_lr: float = 0.001
+    momentum: float = 0.9
+    weight_decay: float = 5e-5
+    label_smoothing: float = 0.0
+    epochs: int = 1
+    warmup_epochs: int = 5
+    lr_decay_epochs: Tuple[int, ...] = (30, 60, 80)
+    lr_decay_factor: float = 0.1
+    scale_lr_by_world_size: bool = True
+
+    # Data
+    fake: bool = True
+    fake_data_length: int = IMAGENET_TRAIN_LENGTH
+    data_dir: Optional[str] = None
+    val_data_dir: Optional[str] = None
+    validation: bool = False
+    num_workers: int = 4  # Keras NUM_WORKERS (:44-46)
+    prefetch_batches: int = 2
+
+    # Distribution
+    distributed: bool = False
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None → all devices on 'data'
+    mesh_axes: Tuple[str, ...] = ("data",)
+
+    # Bookkeeping
+    seed: int = 42  # reference _SEED=42 (PyTorch :274-277, TF fake data :284)
+    model_dir: Optional[str] = None  # AZ_BATCHAI_OUTPUT_MODEL equivalent
+    checkpoint_every_epochs: int = 1
+    resume: bool = True
+    log_every_steps: int = 100  # PyTorch logs per-100-steps (:219-221)
+
+    @property
+    def global_batch_size(self) -> int:
+        import jax
+
+        return self.batch_size_per_device * jax.device_count()
+
+    def steps_per_epoch(self, data_length: Optional[int] = None) -> int:
+        n = data_length if data_length is not None else self.fake_data_length
+        return max(n // self.global_batch_size, 1)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None, **overrides) -> "TrainConfig":
+        """Build a config from the reference's env-var contract.
+
+        Recognized vars (reference docstrings, e.g.
+        ``imagenet_estimator_tf_horovod.py:1-9`` and ``:36-52``):
+        ``DISTRIBUTED``, ``FAKE``, ``FAKE_DATA_LENGTH``, ``EPOCHS``,
+        ``VALIDATION``, ``BATCHSIZE``, ``LR``, ``NUM_WORKERS``, ``MODEL``,
+        ``SEED``, plus the Batch-AI-style path contract
+        ``AZ_BATCHAI_INPUT_TRAIN``/``AZ_BATCHAI_INPUT_TEST``/
+        ``AZ_BATCHAI_OUTPUT_MODEL`` and their plain spellings
+        ``DATA_DIR``/``VAL_DATA_DIR``/``MODEL_DIR``.
+        """
+        e = _env(env)
+        kw = {}
+        if "DISTRIBUTED" in e:
+            kw["distributed"] = _str_to_bool(e["DISTRIBUTED"])
+        if "FAKE" in e:
+            kw["fake"] = _str_to_bool(e["FAKE"])
+        if "VALIDATION" in e:
+            kw["validation"] = _str_to_bool(e["VALIDATION"])
+        if "FAKE_DATA_LENGTH" in e:
+            kw["fake_data_length"] = int(e["FAKE_DATA_LENGTH"])
+        if "EPOCHS" in e:
+            kw["epochs"] = int(e["EPOCHS"])  # fixes reference defect §2c.2
+        if "BATCHSIZE" in e:
+            kw["batch_size_per_device"] = int(e["BATCHSIZE"])
+        if "LR" in e:
+            kw["base_lr"] = float(e["LR"])
+        if "NUM_WORKERS" in e:
+            kw["num_workers"] = int(e["NUM_WORKERS"])
+        if "MODEL" in e:
+            kw["model"] = e["MODEL"]
+        if "SEED" in e:
+            kw["seed"] = int(e["SEED"])
+        # Path contract: Batch AI spellings take precedence (same decoupling
+        # the reference relies on — SURVEY.md §1 env-var boundary).
+        data_dir = e.get("AZ_BATCHAI_INPUT_TRAIN") or e.get("DATA_DIR")
+        val_dir = e.get("AZ_BATCHAI_INPUT_TEST") or e.get("VAL_DATA_DIR")
+        model_dir = e.get("AZ_BATCHAI_OUTPUT_MODEL") or e.get("MODEL_DIR")
+        if data_dir:
+            kw["data_dir"] = data_dir
+        if val_dir:
+            kw["val_data_dir"] = val_dir
+        if model_dir:
+            kw["model_dir"] = model_dir
+        kw.update(overrides)
+        return cls(**kw)
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

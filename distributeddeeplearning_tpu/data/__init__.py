@@ -1,0 +1,4 @@
+from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
+from distributeddeeplearning_tpu.data.pipeline import shard_batch, prefetch_to_device
+
+__all__ = ["SyntheticImageDataset", "shard_batch", "prefetch_to_device"]

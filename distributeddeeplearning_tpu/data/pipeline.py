@@ -1,0 +1,103 @@
+"""Host→device staging: global-array assembly + async prefetch.
+
+The reference's input-pipeline performance tier is tf.data threads
+(``parallel_interleave``/``map_and_batch``, prefetch 256 —
+``imagenet_estimator_tf_horovod.py:249-259``) and Keras multiprocess
+workers (``:332-342``). The TPU-native equivalent is (a) building *global*
+jax.Arrays from per-host numpy shards so a jitted step sees one logical
+batch regardless of process count, and (b) a background thread keeping
+``prefetch_batches`` batches resident in HBM so the step never waits on
+PCIe (HBM-bandwidth rule: overlap host transfer with compute).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from distributeddeeplearning_tpu.parallel.mesh import batch_sharding
+
+PyTree = Any
+
+
+def shard_batch(batch: PyTree, mesh: Mesh, sharding: Optional[NamedSharding] = None) -> PyTree:
+    """Place a process-local numpy batch as a global, batch-sharded jax.Array.
+
+    Single-process: a plain sharded ``device_put``. Multi-host: each process
+    contributes its local shard and the result is a global array spanning
+    the mesh (``make_array_from_process_local_data`` — the moment the
+    reference's per-rank ``DistributedSampler`` shards become one logical
+    batch).
+    """
+    sh = sharding or batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sh)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sh, x), batch
+    )
+
+
+def prefetch_to_device(
+    it: Iterable[PyTree],
+    mesh: Mesh,
+    *,
+    size: int = 2,
+    sharding: Optional[NamedSharding] = None,
+) -> Iterator[PyTree]:
+    """Asynchronously stage batches onto the mesh, ``size`` deep.
+
+    A daemon thread pulls from ``it``, calls :func:`shard_batch` (device
+    transfer starts immediately; JAX transfers are async), and the consumer
+    pops fully-staged batches. Equivalent role to the reference's
+    ``prefetch(256)`` (TF ``:258``) + pinned-memory DataLoader (PyTorch
+    ``:313-316``).
+    """
+    if size <= 0:
+        for batch in it:
+            yield shard_batch(batch, mesh, sharding)
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    _END = object()
+    err: list = []
+    cancelled = threading.Event()
+
+    def _put(item) -> bool:
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for batch in it:
+                if not _put(shard_batch(batch, mesh, sharding)):
+                    return  # consumer gone: stop staging, free HBM refs
+        except Exception as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Consumer abandoned the generator (break / exception / close):
+        # unblock and terminate the producer so staged device batches and
+        # the thread are released rather than pinned for the process life.
+        cancelled.set()

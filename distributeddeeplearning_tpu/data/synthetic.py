@@ -1,0 +1,104 @@
+"""Seeded synthetic dataset with virtual length — the universal fake backend.
+
+Capability parity with the reference's ``FAKE=True`` mode, its de-facto
+test/benchmark infrastructure (SURVEY.md §4.1): a small *physical* pool of
+seeded random batches indexed through a random ``translation_index`` of
+*virtual* length N, giving realistic epoch size without disk. Reference
+implementations: TF ``_create_fake_data_fn`` (``imagenet_estimator_tf_
+horovod.py:295-345``, seed 42 at ``:284-287``), Keras ``FakeDataGenerator``
+(``HorovodKeras/src/data_generator.py:22-53``, pool of 20 batches,
+translation index at ``:45,52``), PyTorch ``FakeData``
+(``imagenet_pytorch_horovod.py:146-191``).
+
+TPU-first differences: NHWC layout (XLA:TPU's preferred conv layout, vs
+the reference's NCHW-for-cuDNN), per-process sharding built in (each host
+yields only its slice of the global batch, the ``DistributedSampler``
+equivalent — reference PyTorch ``:258-264``), and batches are yielded as
+numpy for zero-copy ``device_put``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class SyntheticImageDataset:
+    """Seeded random images + labels with a virtual length.
+
+    Parameters mirror the reference contract: ``length`` is the virtual
+    dataset size (``FAKE_DATA_LENGTH``, default 1,281,167 = ImageNet),
+    ``num_physical_batches`` the real pool size (reference uses 20,
+    ``data_generator.py:30``).
+    """
+
+    def __init__(
+        self,
+        *,
+        length: int = 1_281_167,
+        global_batch_size: int,
+        image_size: int = 224,
+        num_classes: int = 1000,
+        channels: int = 3,
+        num_physical_batches: int = 20,
+        seed: int = 42,
+        process_index: int = 0,
+        process_count: int = 1,
+        one_hot: bool = False,
+        dtype: np.dtype = np.float32,
+    ):
+        if global_batch_size % process_count != 0:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{process_count} processes"
+            )
+        self.length = length
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // process_count
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.one_hot = one_hot
+        self.process_index = process_index
+        self.process_count = process_count
+
+        rng = np.random.RandomState(seed)  # seed 42 parity (TF :284-287)
+        pool_n = num_physical_batches * self.local_batch_size
+        self._images = rng.uniform(
+            -1.0, 1.0, size=(pool_n, image_size, image_size, channels)
+        ).astype(dtype)
+        self._labels = rng.randint(0, num_classes, size=(pool_n,)).astype(np.int32)
+        # Virtual→physical translation index (reference data_generator.py:45).
+        # Sized to the *local* share of the virtual length; offset by process
+        # index so hosts draw disjoint streams (DistributedSampler parity).
+        local_len = length // process_count
+        self._idx_seed = (seed + 1 + process_index) % (2**31 - 1)
+        idx_rng = np.random.RandomState(self._idx_seed)
+        self._translation_index = idx_rng.randint(0, pool_n, size=(local_len,))
+        self.steps_per_epoch = max(length // global_batch_size, 1)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def epoch(self, epoch_index: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield ``steps_per_epoch`` local batches ``(images, labels)``.
+
+        Deterministic in ``(seed, epoch_index, process_index)`` — the
+        reference reshuffles its index each epoch (Keras
+        ``_set_index_array``); we deterministically re-permute the
+        translation index per epoch.
+        """
+        b = self.local_batch_size
+        perm_rng = np.random.RandomState((self._idx_seed + 7919 * epoch_index) % (2**31 - 1))
+        index = perm_rng.permutation(self._translation_index)
+        for step in range(self.steps_per_epoch):
+            start = step * b
+            sel = index[np.arange(start, start + b) % len(index)]
+            images = self._images[sel]
+            labels = self._labels[sel]
+            if self.one_hot:
+                labels = np.eye(self.num_classes, dtype=np.float32)[labels]
+            yield images, labels
+
+    def __iter__(self):
+        return self.epoch(0)

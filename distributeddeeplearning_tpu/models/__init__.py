@@ -1,0 +1,64 @@
+"""Model zoo + registry.
+
+The reference's "zoo" is one model reached three ways (first-party TF
+graph builder, ``keras.applications.resnet50``, ``torchvision resnet50``
+— SURVEY.md §2). Here one registry serves every front-end; BASELINE.json
+additionally calls for EfficientNet-B4 and ViT-B/16 configs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+    ResNet200,
+    resnet_v1,
+)
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str, factory: Callable[..., Any]) -> None:
+    _REGISTRY[name.lower()] = factory
+
+
+def get_model(name: str, *, num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+    """Instantiate a model by name (e.g. ``"resnet50"``)."""
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[key](num_classes=num_classes, dtype=dtype, **kw)
+
+
+def available_models():
+    return sorted(_REGISTRY)
+
+
+for _depth in (18, 34, 50, 101, 152, 200):
+    register_model(
+        f"resnet{_depth}",
+        (lambda d: (lambda num_classes=1000, dtype=jnp.bfloat16, **kw: ResNet(
+            depth=d, num_classes=num_classes, dtype=dtype, **kw)))(_depth),
+    )
+
+__all__ = [
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "ResNet200",
+    "resnet_v1",
+    "get_model",
+    "register_model",
+    "available_models",
+]

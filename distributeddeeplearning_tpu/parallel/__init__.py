@@ -1,0 +1,4 @@
+from distributeddeeplearning_tpu.parallel.mesh import MeshConfig, create_mesh
+from distributeddeeplearning_tpu.parallel import collectives
+
+__all__ = ["MeshConfig", "create_mesh", "collectives"]

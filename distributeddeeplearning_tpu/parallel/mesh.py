@@ -1,0 +1,115 @@
+"""Device-mesh construction — the TPU-native replacement for mpirun topology.
+
+The reference's topology is implicit in its launcher: ``mpirun -np 8
+--hostfile $AZ_BATCHAI_MPI_HOST_FILE`` forks one process per GPU across
+nodes (``Horovod*/01_Train*.ipynb`` cell 15) and Horovod exposes
+``rank/local_rank/size``. On TPU the topology is a
+``jax.sharding.Mesh`` over all addressable chips: XLA compiles collectives
+onto ICI within a slice and DCN across slices, so mesh axis *order*
+determines which links a collective rides (SURVEY.md §2a).
+
+Axis convention (outer → inner):
+  ``("replica", "data", "model", "seq")`` — any subset may be present.
+  * ``data``  — batch sharding (the reference's only axis, §2b)
+  * ``model`` — tensor parallelism (ViT path)
+  * ``seq``   — sequence/context parallelism (ring attention)
+  * ``replica`` — pure replication / multi-slice DCN axis
+For multi-slice topologies put the slower axis (DCN) outermost so
+data-parallel gradient reduction rides ICI within a slice first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, in canonical outer→inner order.
+REPLICA_AXIS = "replica"
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+CANONICAL_AXES = (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh spec. ``shape[i]`` of ``-1`` means "all remaining"."""
+
+    axes: Tuple[str, ...] = (DATA_AXIS,)
+    shape: Tuple[int, ...] = (-1,)
+
+    def resolve_shape(self, n_devices: int) -> Tuple[int, ...]:
+        shape = list(self.shape)
+        if len(shape) != len(self.axes):
+            raise ValueError(f"axes {self.axes} vs shape {self.shape} length mismatch")
+        fixed = math.prod(s for s in shape if s != -1)
+        n_wild = shape.count(-1)
+        if n_wild > 1:
+            raise ValueError("at most one -1 wildcard in mesh shape")
+        if n_wild == 1:
+            if n_devices % fixed != 0:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            shape[shape.index(-1)] = n_devices // fixed
+        if math.prod(shape) != n_devices:
+            raise ValueError(
+                f"mesh shape {tuple(shape)} does not cover {n_devices} devices"
+            )
+        return tuple(shape)
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axes: Optional[Sequence[str]] = None,
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a named mesh over all (or the given) devices.
+
+    ``create_mesh()`` with no args = all devices on one ``data`` axis —
+    the reference's sync-DP world (``hvd.size()`` ≙ mesh size).
+    """
+    if config is None:
+        resolved_axes = tuple(axes) if axes is not None else (DATA_AXIS,)
+        if shape is not None:
+            resolved_shape = tuple(shape)
+        else:
+            # axes-only construction: all devices go to the LAST axis,
+            # earlier axes get size 1 (at most one -1 wildcard is allowed).
+            resolved_shape = (1,) * (len(resolved_axes) - 1) + (-1,)
+        config = MeshConfig(axes=resolved_axes, shape=resolved_shape)
+    devs = list(devices) if devices is not None else jax.devices()
+    resolved = config.resolve_shape(len(devs))
+    device_array = np.asarray(devs).reshape(resolved)
+    return Mesh(device_array, config.axes)
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """All devices on the ``data`` axis (reference parity topology, §2b)."""
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    return create_mesh(devices=devs)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a host-order batch: leading dim split over every
+    batch-like axis present in the mesh (``replica`` × ``data``)."""
+    batch_axes = tuple(a for a in (REPLICA_AXIS, DATA_AXIS) if a in mesh.axis_names)
+    spec = P(batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in (REPLICA_AXIS, DATA_AXIS) if a in mesh.axis_names)
+
+
+def dp_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[a] for a in batch_axes(mesh)) or 1

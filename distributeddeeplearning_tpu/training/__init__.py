@@ -1,0 +1,17 @@
+from distributeddeeplearning_tpu.training.state import TrainState
+from distributeddeeplearning_tpu.training.schedules import create_lr_schedule
+from distributeddeeplearning_tpu.training.optimizer import create_optimizer
+from distributeddeeplearning_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+)
+
+__all__ = [
+    "TrainState",
+    "create_lr_schedule",
+    "create_optimizer",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+]

@@ -1,0 +1,33 @@
+"""Optimizer construction.
+
+Reference optimizers: TF ``MomentumOptimizer(lr×size, momentum=.9)``
+wrapped in ``hvd.DistributedOptimizer`` (``imagenet_estimator_tf_horovod.
+py:149-160``), Keras SGD+momentum with L2 5e-5 injected into the model
+(``imagenet_keras_horovod.py:97-116, 155-166``), PyTorch plain SGD
+(``:333``). Here: optax SGD-with-momentum driven by the warmup/decay
+schedule; the Distributed wrapper is unnecessary — gradient allreduce
+lives inside the jitted step (see ``train_step.py``). Weight decay is
+applied as L2 on kernel params in the loss (Keras parity) rather than
+decoupled, so the three front-ends share one optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import optax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.training.schedules import create_lr_schedule
+
+
+def create_optimizer(
+    config: TrainConfig,
+    steps_per_epoch: int,
+    world_size: Optional[int] = None,
+) -> Tuple[optax.GradientTransformation, optax.Schedule]:
+    """Returns ``(tx, lr_schedule)``; the schedule is also returned so
+    callbacks/loggers can report the current LR (Keras-parity)."""
+    schedule = create_lr_schedule(config, steps_per_epoch, world_size)
+    tx = optax.sgd(learning_rate=schedule, momentum=config.momentum, nesterov=False)
+    return tx, schedule

@@ -1,0 +1,57 @@
+"""Learning-rate schedules — parity with the reference's richest (Keras) path.
+
+The reference Keras trainer implements the arXiv:1706.02677 recipe (cited
+at ``imagenet_keras_horovod.py:40-42``): base LR scaled by world size
+(``:157-162``), 5-epoch linear warmup (``LearningRateWarmupCallback``,
+``:211-213``) and stepwise ×0.1 decay at epochs 30/60/80 (``:215-224``).
+The TF and PyTorch paths scale LR by world size only (TF ``:154``, PyTorch
+``:333``). Here the same recipe is an optax schedule compiled into the
+step — no callback machinery needed at the runtime layer (the Keras-style
+front-end still exposes callbacks for API parity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import optax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+
+
+def create_lr_schedule(
+    config: TrainConfig,
+    steps_per_epoch: int,
+    world_size: Optional[int] = None,
+) -> optax.Schedule:
+    """Linear-warmup → piecewise-constant-decay schedule.
+
+    ``world_size`` defaults to the device count; peak LR = base_lr ×
+    world_size (reference LR rule, BASELINE.md).
+    """
+    if world_size is None:
+        import jax
+
+        world_size = jax.device_count()
+    peak = config.base_lr * (world_size if config.scale_lr_by_world_size else 1)
+    warmup_steps = config.warmup_epochs * steps_per_epoch
+
+    def decay_boundaries(offset: int):
+        # join_schedules passes (step - warmup_steps) to the post-warmup
+        # schedule, so boundaries must be pre-offset or decay would fire
+        # warmup_epochs late (at 35/65/85 instead of 30/60/80).
+        return {
+            int(e * steps_per_epoch) - offset: config.lr_decay_factor
+            for e in config.lr_decay_epochs
+            if int(e * steps_per_epoch) - offset > 0
+        }
+
+    if warmup_steps <= 0:
+        return optax.piecewise_constant_schedule(peak, decay_boundaries(0))
+    decay = optax.piecewise_constant_schedule(peak, decay_boundaries(warmup_steps))
+    warmup = optax.linear_schedule(
+        init_value=peak / max(world_size, 1),  # warm from single-device LR
+        end_value=peak,
+        transition_steps=warmup_steps,
+    )
+    return optax.join_schedules([warmup, decay], boundaries=[warmup_steps])

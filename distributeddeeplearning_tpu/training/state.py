@@ -1,0 +1,35 @@
+"""Training state pytree.
+
+One immutable pytree carrying everything the jitted step updates: params,
+BN running statistics, optimizer state, step counter. The reference keeps
+the analogous state inside three different runtimes (tf.estimator
+checkpoint state, Keras model + optimizer, torch module + optimizer); here
+it is a single functional object that flows through ``train_step`` and is
+what orbax checkpoints (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import optax
+
+PyTree = Any
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jnp.ndarray  # int32 scalar
+    params: PyTree
+    batch_stats: PyTree  # BN running mean/var (momentum .9, eps 1e-5 parity)
+    opt_state: optax.OptState
+
+    @classmethod
+    def create(cls, *, params, batch_stats, tx: optax.GradientTransformation):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+        )

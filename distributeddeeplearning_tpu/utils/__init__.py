@@ -1,0 +1,4 @@
+from distributeddeeplearning_tpu.utils.timer import Timer, timer
+from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
+
+__all__ = ["Timer", "timer", "get_logger", "log_summary"]

@@ -1,0 +1,36 @@
+"""Test backbone: 8 virtual CPU devices running the real distributed code.
+
+This is the TPU-build analogue of the reference's local smoke test
+(``mpirun -np 2 -H localhost:2`` in ``Horovod*/00_CreateImageAndTest.ipynb``
+cells 6-10, SURVEY.md §4.2): the *same* mesh/shard_map code path that runs
+on a pod runs here on 8 forced host devices. Must run before jax
+initialises a backend; the axon TPU plugin force-sets
+``jax_platforms='axon,cpu'`` at interpreter start, so we re-force cpu via
+config (env vars alone are overridden).
+"""
+
+import os
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 forced CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+
+    return data_parallel_mesh()

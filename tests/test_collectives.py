@@ -1,0 +1,108 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel import collectives
+from distributeddeeplearning_tpu.parallel.mesh import (
+    MeshConfig,
+    batch_sharding,
+    create_mesh,
+    data_parallel_mesh,
+    dp_size,
+)
+
+
+def test_topology(devices):
+    assert collectives.size() == 8
+    assert collectives.rank() == 0
+    assert collectives.is_master()
+    assert collectives.num_processes() == 1
+
+
+def test_mesh_default_all_data(mesh8):
+    assert mesh8.axis_names == ("data",)
+    assert mesh8.shape["data"] == 8
+    assert dp_size(mesh8) == 8
+
+
+def test_mesh_wildcard_resolution():
+    cfg = MeshConfig(axes=("data", "model"), shape=(-1, 2))
+    assert cfg.resolve_shape(8) == (4, 2)
+    mesh = create_mesh(cfg)
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+
+def test_mesh_bad_shape_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        MeshConfig(axes=("data",), shape=(3,)).resolve_shape(8)
+    with pytest.raises(ValueError):
+        MeshConfig(axes=("a", "b"), shape=(-1, -1)).resolve_shape(8)
+
+
+def test_allreduce_gradients_means_across_shards(mesh8):
+    # Each device holds a distinct value; pmean must average all 8.
+    x = jnp.arange(8.0)
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda v: collectives.allreduce_gradients(v, "data"),
+            mesh=mesh8,
+            in_specs=P("data"),
+            out_specs=P("data"),
+        )
+    )
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
+
+
+def test_allreduce_sum(mesh8):
+    x = jnp.ones(8)
+    f = jax.jit(
+        jax.shard_map(
+            lambda v: collectives.allreduce_sum(v, "data"),
+            mesh=mesh8,
+            in_specs=P("data"),
+            out_specs=P("data"),
+        )
+    )
+    np.testing.assert_allclose(np.asarray(f(x)), 8.0)
+
+
+def test_broadcast_single_process_identity():
+    tree = {"a": np.ones(3)}
+    out = collectives.broadcast_from_master(tree)
+    np.testing.assert_array_equal(out["a"], tree["a"])
+
+
+def test_host_scalar_single_process():
+    assert collectives.allreduce_host_scalar(2.5) == 2.5
+
+
+def test_batch_sharding_spec(mesh8):
+    sh = batch_sharding(mesh8)
+    x = np.zeros((16, 4))
+    arr = jax.device_put(x, sh)
+    assert arr.sharding.spec == P("data")
+    # each device gets 2 rows
+    assert arr.addressable_shards[0].data.shape == (2, 4)
+
+
+def test_create_mesh_axes_only_multiaxis(devices):
+    # Regression: axes-only construction used to build (-1, -1) and raise.
+    mesh = create_mesh(axes=("replica", "data"))
+    assert mesh.shape["replica"] == 1 and mesh.shape["data"] == 8
+
+
+def test_eval_step_requires_batch_axis(devices):
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+    from distributeddeeplearning_tpu.models.resnet import ResNet
+    from distributeddeeplearning_tpu.training import make_eval_step
+
+    mesh = Mesh(np.asarray(jax.devices()), ("model",))
+    with pytest.raises(ValueError, match="batch axis"):
+        make_eval_step(ResNet(depth=18), mesh)

@@ -1,0 +1,56 @@
+from distributeddeeplearning_tpu.config import TrainConfig, _str_to_bool
+
+
+def test_defaults_match_reference_constants():
+    c = TrainConfig()
+    assert c.batch_size_per_device == 64  # _BATCHSIZE
+    assert c.base_lr == 0.001  # _LR
+    assert c.momentum == 0.9
+    assert c.fake_data_length == 1_281_167
+    assert c.lr_decay_epochs == (30, 60, 80)
+    assert c.warmup_epochs == 5
+    assert c.seed == 42
+
+
+def test_epochs_env_is_int():
+    # Reference defect §2c.2: EPOCHS env var stayed a str and broke
+    # `_EPOCHS * length`. Must parse to int here.
+    c = TrainConfig.from_env({"EPOCHS": "3"})
+    assert c.epochs == 3
+    assert isinstance(c.epochs * 10, int)
+
+
+def test_bool_parsing_is_strict():
+    # Reference's `"t" in v.lower()` made "faulty" truthy.
+    assert _str_to_bool("True") and _str_to_bool("t") and _str_to_bool("1")
+    assert not _str_to_bool("False") and not _str_to_bool("faulty")
+    assert not _str_to_bool("0")
+
+
+def test_env_contract():
+    env = {
+        "DISTRIBUTED": "True",
+        "FAKE": "False",
+        "FAKE_DATA_LENGTH": "1000",
+        "VALIDATION": "True",
+        "BATCHSIZE": "32",
+        "LR": "0.01",
+        "MODEL": "resnet18",
+        "AZ_BATCHAI_INPUT_TRAIN": "/data/train",
+        "AZ_BATCHAI_INPUT_TEST": "/data/val",
+        "AZ_BATCHAI_OUTPUT_MODEL": "/out",
+    }
+    c = TrainConfig.from_env(env)
+    assert c.distributed and not c.fake and c.validation
+    assert c.fake_data_length == 1000
+    assert c.batch_size_per_device == 32
+    assert c.base_lr == 0.01
+    assert c.model == "resnet18"
+    assert c.data_dir == "/data/train"
+    assert c.val_data_dir == "/data/val"
+    assert c.model_dir == "/out"
+
+
+def test_overrides_beat_env():
+    c = TrainConfig.from_env({"EPOCHS": "3"}, epochs=7)
+    assert c.epochs == 7

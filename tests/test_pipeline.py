@@ -1,0 +1,54 @@
+import threading
+import time
+
+import numpy as np
+
+from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device, shard_batch
+
+
+def _batches(n, size=8):
+    for i in range(n):
+        yield (np.full((size, 2), i, np.float32), np.full((size,), i, np.int32))
+
+
+def test_prefetch_yields_all_sharded(mesh8):
+    out = list(prefetch_to_device(_batches(5), mesh8, size=2))
+    assert len(out) == 5
+    imgs, labels = out[3]
+    assert imgs.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(imgs), 3.0)
+
+
+def test_prefetch_zero_size_passthrough(mesh8):
+    out = list(prefetch_to_device(_batches(3), mesh8, size=0))
+    assert len(out) == 3
+
+
+def test_prefetch_propagates_producer_error(mesh8):
+    def bad():
+        yield from _batches(2)
+        raise RuntimeError("boom")
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(prefetch_to_device(bad(), mesh8, size=1))
+
+
+def test_prefetch_early_abandonment_stops_producer(mesh8):
+    # Regression: abandoning the generator must terminate the producer
+    # thread rather than leaving it blocked on a full queue forever.
+    before = threading.active_count()
+    it = prefetch_to_device(_batches(100), mesh8, size=2)
+    next(it)
+    it.close()  # consumer walks away mid-epoch
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_shard_batch_places_on_mesh(mesh8):
+    imgs = np.zeros((16, 3), np.float32)
+    arr = shard_batch((imgs, np.zeros(16, np.int32)), mesh8)
+    assert arr[0].sharding.mesh.shape["data"] == 8

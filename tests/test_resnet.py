@@ -1,0 +1,85 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models import get_model, available_models
+from distributeddeeplearning_tpu.models.resnet import ResNet, resnet_v1
+
+
+def _init(model, size=32):
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((2, size, size, 3), jnp.float32)
+    return model.init(rng, x, train=False), x
+
+
+def _param_count(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+def test_registry_has_resnet_family():
+    names = available_models()
+    for d in (18, 34, 50, 101, 152, 200):
+        assert f"resnet{d}" in names
+
+
+def test_forward_shape_fp32_logits():
+    model = get_model("resnet18", num_classes=10)
+    variables, x = _init(model)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_resnet50_param_count_matches_reference():
+    # torchvision resnet50 (the reference PyTorch model,
+    # imagenet_pytorch_horovod.py:323) has 25,557,032 params; our v1
+    # builder must match exactly (same architecture, bias-free convs).
+    model = ResNet(depth=50, num_classes=1000, dtype=jnp.float32)
+    variables, _ = _init(model, size=64)
+    assert _param_count(variables["params"]) == 25_557_032
+
+
+def test_resnet18_param_count_matches_reference():
+    model = ResNet(depth=18, num_classes=1000, dtype=jnp.float32)
+    variables, _ = _init(model, size=64)
+    assert _param_count(variables["params"]) == 11_689_512  # torchvision resnet18
+
+
+def test_zero_init_residual_gamma():
+    # reference resnet_model.py:150,201 zero-inits the last BN gamma of
+    # each residual branch.
+    model = ResNet(depth=18, num_classes=10)
+    variables, _ = _init(model)
+    bn2 = variables["params"]["stage1_block1"]["BatchNorm_1"]
+    np.testing.assert_array_equal(np.asarray(bn2["scale"]), 0.0)
+
+
+def test_bad_depth_raises():
+    model = ResNet(depth=77)
+    with pytest.raises(ValueError, match="depth"):
+        _init(model)
+
+
+def test_resnet_v1_factory():
+    m = resnet_v1(34, num_classes=7)
+    assert m.depth == 34 and m.num_classes == 7
+
+
+def test_batch_stats_update_in_train_mode():
+    model = ResNet(depth=18, num_classes=10)
+    variables, x = _init(model)
+    x = jax.random.normal(jax.random.PRNGKey(1), x.shape)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_bfloat16_compute_f32_params():
+    model = ResNet(depth=18, num_classes=10, dtype=jnp.bfloat16)
+    variables, x = _init(model)
+    for leaf in jax.tree.leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32
+    out = model.apply(variables, x, train=False)
+    assert out.dtype == jnp.float32

@@ -1,0 +1,54 @@
+import numpy as np
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.training.schedules import create_lr_schedule
+
+
+SPE = 10  # steps per epoch
+
+
+def _sched(**kw):
+    cfg = TrainConfig(**kw)
+    return create_lr_schedule(cfg, SPE, world_size=8)
+
+
+def test_peak_is_scaled_by_world_size():
+    s = _sched()
+    # after warmup, before first decay epoch
+    assert np.isclose(float(s(10 * SPE)), 0.001 * 8)
+
+
+def test_warmup_ramps_from_single_device_lr():
+    s = _sched()
+    assert np.isclose(float(s(0)), 0.001)
+    assert float(s(2 * SPE)) < float(s(4 * SPE)) < 0.008 + 1e-9
+
+
+def test_decay_fires_at_documented_epochs():
+    # Regression: join_schedules offsets the inner schedule by
+    # warmup_steps, which un-corrected fired decay at 35/65/85.
+    s = _sched()
+    peak = 0.008
+    assert np.isclose(float(s(30 * SPE - 1)), peak)
+    assert np.isclose(float(s(30 * SPE)), peak * 0.1)
+    assert np.isclose(float(s(60 * SPE - 1)), peak * 0.1)
+    assert np.isclose(float(s(60 * SPE)), peak * 0.01)
+    assert np.isclose(float(s(80 * SPE)), peak * 0.001)
+
+
+def test_no_warmup():
+    s = _sched(warmup_epochs=0)
+    assert np.isclose(float(s(0)), 0.008)
+    assert np.isclose(float(s(30 * SPE)), 0.0008)
+
+
+def test_unscaled_lr():
+    s = _sched(scale_lr_by_world_size=False)
+    assert np.isclose(float(s(10 * SPE)), 0.001)
+
+
+def test_decay_epoch_inside_warmup_is_dropped():
+    # decay boundary before warmup end must not produce a negative key
+    s = _sched(warmup_epochs=40, lr_decay_epochs=(30, 60))
+    assert np.isclose(float(s(41 * SPE)), 0.008)  # 30-epoch decay dropped
+    assert np.isclose(float(s(60 * SPE)), 0.0008)

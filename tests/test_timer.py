@@ -1,0 +1,46 @@
+import time
+
+from distributeddeeplearning_tpu.utils.timer import Timer, timer
+
+
+def test_timer_context_manager():
+    with Timer() as t:
+        time.sleep(0.01)
+    assert 0.005 < t.elapsed < 1.0
+
+
+def test_timer_output_sink():
+    out = []
+    with Timer(output=out.append, fmt="{:.1f}"):
+        pass
+    assert len(out) == 1
+
+
+def test_timer_accumulates():
+    t = Timer()
+    t.start()
+    t.stop()
+    first = t.elapsed
+    t.start()
+    time.sleep(0.01)
+    t.stop()
+    assert t.elapsed > first
+
+
+def test_timer_reset():
+    t = Timer()
+    t.start()
+    t.stop()
+    t.reset()
+    assert t.elapsed == 0.0
+
+
+def test_timer_decorator():
+    out = []
+
+    @timer(output=out.append)
+    def add(a, b):
+        return a + b
+
+    assert add(2, 3) == 5
+    assert len(out) == 1 and "add" in out[0]

@@ -1,0 +1,168 @@
+"""End-to-end train-step tests on the 8-device CPU mesh.
+
+The key distributed-correctness assertion (the reference never had one,
+SURVEY.md §4): data-parallel training over 8 shards produces the SAME
+parameter update as single-device training on the full batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.pipeline import shard_batch
+from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
+from distributeddeeplearning_tpu.models.resnet import ResNet
+from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+from distributeddeeplearning_tpu.training import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+CFG = TrainConfig(
+    model="resnet18",
+    num_classes=10,
+    image_size=16,
+    batch_size_per_device=2,
+    weight_decay=1e-4,
+    compute_dtype="float32",
+)
+
+
+def _model():
+    return ResNet(depth=18, num_classes=10, dtype=jnp.float32)
+
+
+def _batch(global_batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.randn(global_batch, 16, 16, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=(global_batch,)).astype(np.int32)
+    return images, labels
+
+
+@pytest.fixture(scope="module")
+def setup(mesh8):
+    model = _model()
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = create_train_state(model, CFG, tx, input_shape=(1, 16, 16, 3))
+    state = replicate_state(state, mesh8)
+    step = make_train_step(model, tx, mesh8, CFG, donate_state=False)
+    return model, tx, state, step
+
+
+def test_train_step_runs_and_metrics(setup, mesh8):
+    _, _, state, step = setup
+    batch = shard_batch(_batch(), mesh8)
+    new_state, metrics = step(state, batch)
+    assert int(new_state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+    assert float(metrics["grad_norm"]) > 0.0
+
+
+def test_loss_decreases_on_fixed_batch(mesh8):
+    # Plain SGD, no momentum/wd: with BN, conv kernels are scale-invariant
+    # and momentum inflates their norm without changing CE, which would
+    # make a loss that *includes* the L2 term non-monotone.
+    model = _model()
+    tx = optax.sgd(0.01)
+    cfg = CFG.replace(weight_decay=0.0)
+    state = replicate_state(
+        create_train_state(model, cfg, tx, input_shape=(1, 16, 16, 3)), mesh8
+    )
+    step = make_train_step(model, tx, mesh8, cfg, donate_state=False)
+    batch = shard_batch(_batch(), mesh8)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_dp_matches_single_device(mesh8):
+    """8-way sharded update == single-device full-batch update.
+
+    BN caveat: per-replica BN statistics (reference parity) make the
+    *forward* differ between 1 and 8 shards, so for this equivalence test
+    the batch is constructed so each shard has identical contents — then
+    local BN stats equal global stats and updates must match exactly.
+    """
+    model = _model()
+    tx = optax.sgd(0.1)
+    state = create_train_state(model, CFG, tx, input_shape=(1, 16, 16, 3))
+
+    shard_imgs, shard_labels = _batch(global_batch=2, seed=3)
+    images = np.tile(shard_imgs, (8, 1, 1, 1))
+    labels = np.tile(shard_labels, 8)
+
+    # single-device reference update (no mesh)
+    mesh1 = create_mesh(devices=jax.devices()[:1])
+    step1 = make_train_step(model, tx, mesh1, CFG, donate_state=False)
+    s1 = replicate_state(state, mesh1)
+    s1, m1 = step1(s1, shard_batch((images, labels), mesh1))
+
+    step8 = make_train_step(model, tx, mesh8, CFG, donate_state=False)
+    s8 = replicate_state(state, mesh8)
+    s8, m8 = step8(s8, shard_batch((images, labels), mesh8))
+
+    # Compare the parameter *updates* by relative norm: f32 reduction-order
+    # noise (16-sample reduce vs 8x2-shard + pmean, BN rsqrt) stays well
+    # under 5%, while the bug class this guards (sum-instead-of-mean
+    # gradient reduction) produces a ratio near 7.
+    np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=1e-4)
+    for p0, a, b in zip(
+        jax.tree.leaves(state.params),
+        jax.tree.leaves(s1.params),
+        jax.tree.leaves(s8.params),
+    ):
+        d1 = np.asarray(a) - np.asarray(p0)
+        d8 = np.asarray(b) - np.asarray(p0)
+        denom = np.linalg.norm(d1) + 1e-12
+        assert np.linalg.norm(d8 - d1) / denom < 0.05
+
+
+def test_eval_step(setup, mesh8):
+    model, _, state, _ = setup
+    eval_step = make_eval_step(model, mesh8)
+    metrics = eval_step(state, shard_batch(_batch(), mesh8))
+    for k in ("loss", "top1", "top5"):
+        assert np.isfinite(float(metrics[k]))
+    assert float(metrics["top5"]) >= float(metrics["top1"])
+
+
+def test_synthetic_pipeline_through_train_step(setup, mesh8):
+    _, _, state, step = setup
+    ds = SyntheticImageDataset(
+        length=64,
+        global_batch_size=16,
+        image_size=16,
+        num_classes=10,
+        num_physical_batches=2,
+        seed=7,
+    )
+    n = 0
+    for images, labels in ds.epoch(0):
+        state, metrics = step(state, shard_batch((images, labels), mesh8))
+        n += 1
+    assert n == ds.steps_per_epoch == 4
+    assert int(state.step) == 4
+
+
+def test_weight_decay_changes_grads(mesh8):
+    model = _model()
+    tx = optax.sgd(0.1)
+    cfg_nowd = CFG.replace(weight_decay=0.0)
+    state = create_train_state(model, CFG, tx, input_shape=(1, 16, 16, 3))
+    batch = shard_batch(_batch(), mesh8)
+
+    s_wd = replicate_state(state, mesh8)
+    s_nw = replicate_state(state, mesh8)
+    _, m_wd = make_train_step(model, tx, mesh8, CFG, donate_state=False)(s_wd, batch)
+    _, m_nw = make_train_step(model, tx, mesh8, cfg_nowd, donate_state=False)(
+        s_nw, batch
+    )
+    assert float(m_wd["loss"]) > float(m_nw["loss"])  # L2 penalty added
