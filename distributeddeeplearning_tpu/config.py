@@ -62,6 +62,9 @@ class TrainConfig:
     warmup_epochs: int = 5
     lr_decay_epochs: Tuple[int, ...] = (30, 60, 80)
     lr_decay_factor: float = 0.1
+    # Optional per-boundary multiplicative factors (same length as
+    # lr_decay_epochs); overrides the uniform lr_decay_factor when set.
+    lr_decay_factors: Optional[Tuple[float, ...]] = None
     scale_lr_by_world_size: bool = True
 
     # Data
@@ -130,6 +133,12 @@ class TrainConfig:
             kw["model"] = e["MODEL"]
         if "SEED" in e:
             kw["seed"] = int(e["SEED"])
+        # Smoke-test knobs (not in the reference contract): shrink the
+        # problem so the identical code path runs fast on CPU.
+        if "IMAGE_SIZE" in e:
+            kw["image_size"] = int(e["IMAGE_SIZE"])
+        if "NUM_CLASSES" in e:
+            kw["num_classes"] = int(e["NUM_CLASSES"])
         # Path contract: Batch AI spellings take precedence (same decoupling
         # the reference relies on — SURVEY.md §1 env-var boundary).
         data_dir = e.get("AZ_BATCHAI_INPUT_TRAIN") or e.get("DATA_DIR")

@@ -1,4 +1,49 @@
 from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
 from distributeddeeplearning_tpu.data.pipeline import shard_batch, prefetch_to_device
 
-__all__ = ["SyntheticImageDataset", "shard_batch", "prefetch_to_device"]
+
+def make_dataset(config, train: bool = True):
+    """Dataset factory honoring the reference's FAKE switch (SURVEY.md §4.1):
+    synthetic when ``config.fake`` or no data dir, else the real ImageNet
+    pipeline."""
+    import jax
+
+    if config.fake or not (config.data_dir if train else config.val_data_dir):
+        return SyntheticImageDataset(
+            length=config.fake_data_length
+            if train
+            else max(config.fake_data_length // 25, config.global_batch_size),
+            global_batch_size=config.global_batch_size,
+            image_size=config.image_size,
+            num_classes=config.num_classes,
+            seed=config.seed if train else config.seed + 10_000,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+    from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
+
+    return ImageFolderDataset(
+        config.data_dir if train else config.val_data_dir,
+        global_batch_size=config.global_batch_size,
+        image_size=config.image_size,
+        train=train,
+        seed=config.seed,
+        num_workers=config.num_workers,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+
+
+def make_input_fn(train: bool = True):
+    """Estimator-style input_fn factory (reference ``_create_data_fn``/
+    ``_create_fake_data_fn``, ``imagenet_estimator_tf_horovod.py:235-345``)."""
+    return lambda config: make_dataset(config, train=train)
+
+
+__all__ = [
+    "SyntheticImageDataset",
+    "shard_batch",
+    "prefetch_to_device",
+    "make_dataset",
+    "make_input_fn",
+]

@@ -1,0 +1,142 @@
+"""Dataset preparation tools — the reference's data-prep layer, as a CLI.
+
+Replaces two reference components (SURVEY.md §2 "Data prep pipeline"):
+
+* ``valprep`` — ``valprep.sh`` is a generated 51,002-line Bash script of
+  ``mkdir -p``/``mv`` commands sorting the 50k ILSVRC2012 validation
+  images into 1,000 wnid class dirs. Here: :func:`sort_val_images`, a
+  few lines driven by a mapping file (``<image> <wnid>`` per line)
+  instead of 50k hardcoded commands.
+* ``00_DataProcessing.ipynb`` — untar/retar for NFS staging. On TPU the
+  staging format is sharded TFRecords (:func:`write_tfrecords`), which
+  the ``TFRecordImageNetDataset`` reads at accelerator rate.
+
+CLI::
+
+    python -m distributeddeeplearning_tpu.data.prepare valprep \
+        --val-dir ILSVRC2012_val --mapping val_wnids.txt --out val
+    python -m distributeddeeplearning_tpu.data.prepare tfrecords \
+        --src train --out tfrecords/train --num-shards 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+from typing import List, Optional, Tuple
+
+
+def sort_val_images(val_dir: str, mapping_file: str, out_dir: str) -> int:
+    """Sort flat validation images into per-wnid dirs (valprep.sh parity).
+
+    ``mapping_file`` lines: ``ILSVRC2012_val_00000001.JPEG n01751748``.
+    Returns the number of files moved. Missing images are skipped with a
+    report rather than failing the whole run (the Bash version just
+    errored mid-way).
+    """
+    moved = 0
+    missing = 0
+    os.makedirs(out_dir, exist_ok=True)
+    with open(mapping_file) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) != 2:
+                continue
+            image, wnid = parts
+            src = os.path.join(val_dir, image)
+            if not os.path.exists(src):
+                missing += 1
+                continue
+            dst_dir = os.path.join(out_dir, wnid)
+            os.makedirs(dst_dir, exist_ok=True)
+            shutil.move(src, os.path.join(dst_dir, image))
+            moved += 1
+    if missing:
+        print(f"warning: {missing} images in mapping not found", file=sys.stderr)
+    return moved
+
+
+def write_tfrecords(
+    src_dir: str,
+    out_dir: str,
+    num_shards: int = 128,
+    prefix: str = "imagenet",
+    limit: Optional[int] = None,
+) -> Tuple[int, List[str]]:
+    """Convert an ImageFolder layout into sharded TFRecords.
+
+    Writes ``{prefix}-{shard:05d}-of-{num_shards:05d}`` files whose
+    records carry ``image/encoded`` (the original JPEG bytes — no
+    re-encode) and ``image/class/label``. Returns (num_images, classes).
+    """
+    import tensorflow as tf
+
+    from distributeddeeplearning_tpu.data.imagenet import _list_samples
+
+    samples, classes = _list_samples(src_dir)
+    if limit:
+        samples = samples[:limit]
+    os.makedirs(out_dir, exist_ok=True)
+    # One shard (and one open fd) at a time — a 1024-writer fan-out would
+    # blow the default ulimit. Samples are interleaved across shards so
+    # each shard stays class-balanced.
+    for shard in range(num_shards):
+        shard_path = os.path.join(
+            out_dir, f"{prefix}-{shard:05d}-of-{num_shards:05d}"
+        )
+        with tf.io.TFRecordWriter(shard_path) as writer:
+            for path, label in samples[shard::num_shards]:
+                with open(path, "rb") as f:
+                    encoded = f.read()
+                ex = tf.train.Example(
+                    features=tf.train.Features(
+                        feature={
+                            "image/encoded": tf.train.Feature(
+                                bytes_list=tf.train.BytesList(value=[encoded])
+                            ),
+                            "image/class/label": tf.train.Feature(
+                                int64_list=tf.train.Int64List(value=[label])
+                            ),
+                        }
+                    )
+                )
+                writer.write(ex.SerializeToString())
+    with open(os.path.join(out_dir, "classes.txt"), "w") as f:
+        f.write("\n".join(classes) + "\n")
+    with open(os.path.join(out_dir, "count.txt"), "w") as f:
+        f.write(f"{len(samples)}\n")
+    return len(samples), classes
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="prepare", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    vp = sub.add_parser("valprep", help="sort validation images into wnid dirs")
+    vp.add_argument("--val-dir", required=True)
+    vp.add_argument("--mapping", required=True)
+    vp.add_argument("--out", required=True)
+
+    tr = sub.add_parser("tfrecords", help="ImageFolder layout -> TFRecord shards")
+    tr.add_argument("--src", required=True)
+    tr.add_argument("--out", required=True)
+    tr.add_argument("--num-shards", type=int, default=128)
+    tr.add_argument("--prefix", default="imagenet")
+    tr.add_argument("--limit", type=int, default=None)
+
+    args = p.parse_args(argv)
+    if args.cmd == "valprep":
+        n = sort_val_images(args.val_dir, args.mapping, args.out)
+        print(f"moved {n} images")
+    elif args.cmd == "tfrecords":
+        n, classes = write_tfrecords(
+            args.src, args.out, args.num_shards, args.prefix, args.limit
+        )
+        print(f"wrote {n} images, {len(classes)} classes -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

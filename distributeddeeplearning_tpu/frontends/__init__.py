@@ -1,0 +1,17 @@
+"""Three API front-ends over one engine (SURVEY.md §7).
+
+The reference reaches one capability through three frameworks
+(tf.estimator / Keras / PyTorch); here three API *styles* wrap the single
+engine in ``training/loop.py``:
+
+* :mod:`estimator` — ``Estimator(model_fn).train(input_fn, ...)``
+* :mod:`keras_style` — ``Model.compile(...).fit(..., callbacks=[...])``
+* :mod:`explicit` — the hand-written-loop style: you own the loop, we
+  provide the compiled pieces.
+"""
+
+from distributeddeeplearning_tpu.frontends.estimator import Estimator, RunConfig
+from distributeddeeplearning_tpu.frontends.keras_style import Model
+from distributeddeeplearning_tpu.frontends import explicit
+
+__all__ = ["Estimator", "RunConfig", "Model", "explicit"]

@@ -1,0 +1,108 @@
+"""Estimator-style front-end — parity with the reference TF path.
+
+Reference shape (``imagenet_estimator_tf_horovod.py:413-455``): build a
+``RunConfig`` (``_get_runconfig`` :348-361), an ``Estimator(model_fn,
+model_dir, params)`` (:436-438), then ``model.train(input_fn, steps,
+hooks)`` / ``model.evaluate(input_fn)`` (:444-455). Same surface here:
+``model_fn`` returns the model (from our zoo or any Flax module);
+``input_fn`` returns an engine dataset; hooks are callbacks.
+
+What the reference's pieces became:
+* ``_get_runconfig`` GPU pinning (:352-358) → nothing to pin; the mesh
+  covers all local TPU chips automatically.
+* ``_get_model_dir`` rank-0/temp-dir split (:364-374) → orbax handles
+  multi-host coordination; one directory.
+* ``BroadcastGlobalVariablesHook(0)`` (:380) → deterministic seeded init.
+* ``steps // hvd.size()`` (:446) → the dataset yields *global* batches;
+  steps_per_epoch already accounts for world size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.training import loop as engine
+from distributeddeeplearning_tpu.training.callbacks import Callback
+from distributeddeeplearning_tpu.training.state import TrainState
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Reference ``_get_runconfig`` equivalent: run-level knobs that are
+    not hyperparameters."""
+
+    model_dir: Optional[str] = None
+    save_checkpoints_epochs: int = 1
+    keep_checkpoint_max: int = 3
+    mesh: object = None
+
+
+class Estimator:
+    def __init__(
+        self,
+        model_fn: Callable[[TrainConfig], object] | str,
+        config: Optional[TrainConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.config = config or TrainConfig()
+        self.run_config = run_config or RunConfig(model_dir=self.config.model_dir)
+        if isinstance(model_fn, str):
+            name = model_fn
+            model_fn = lambda cfg: get_model(name, num_classes=cfg.num_classes)
+        self.model = model_fn(self.config)
+        self._state: Optional[TrainState] = None
+        self._ckpt = None
+        if self.run_config.model_dir:
+            from distributeddeeplearning_tpu.training.checkpoint import (
+                CheckpointManager,
+            )
+
+            self._ckpt = CheckpointManager(
+                self.run_config.model_dir,
+                max_to_keep=self.run_config.keep_checkpoint_max,
+                save_every_epochs=self.run_config.save_checkpoints_epochs,
+            )
+
+    def train(
+        self,
+        input_fn: Callable[[TrainConfig], engine.EpochDataset],
+        epochs: Optional[int] = None,
+        hooks: Sequence[Callback] = (),
+    ) -> "Estimator":
+        data = input_fn(self.config)
+        result = engine.fit(
+            self.model,
+            self.config,
+            data,
+            mesh=self.run_config.mesh,
+            epochs=epochs,
+            callbacks=hooks,
+            checkpoint_manager=self._ckpt,
+            state=self._state_host(),
+        )
+        self._state = result.state
+        self.last_result = result
+        return self
+
+    def evaluate(
+        self, input_fn: Callable[[TrainConfig], engine.EpochDataset]
+    ) -> Dict[str, float]:
+        if self._state is None:
+            raise RuntimeError("call train() before evaluate(), or restore")
+        return engine.evaluate(
+            self.model,
+            self.config,
+            input_fn(self.config),
+            self._state,
+            mesh=self.run_config.mesh,
+        )
+
+    def _state_host(self):
+        return self._state
+
+    @property
+    def state(self) -> Optional[TrainState]:
+        return self._state

@@ -1,0 +1,142 @@
+"""Keras-style front-end — compile/fit with callbacks.
+
+Parity with the reference Keras mainline (``imagenet_keras_horovod.py:
+273-353``): ``model.compile(optimizer, loss, metrics)`` then
+``model.fit(data, epochs, callbacks=[...])`` with the callback set the
+reference uses (Broadcast, MetricAverage, warmup, schedule, logger,
+checkpoint — see ``training/callbacks.py``). The warmup/schedule
+callbacks are read HERE, at fit time, to build the optax schedule that is
+compiled into the step — the declarative-marker design that keeps the hot
+loop host-free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.training import loop as engine
+from distributeddeeplearning_tpu.training.callbacks import (
+    Callback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+)
+from distributeddeeplearning_tpu.training.optimizer import create_optimizer
+from distributeddeeplearning_tpu.training.state import TrainState
+
+
+class Model:
+    def __init__(self, module_or_name, config: Optional[TrainConfig] = None, mesh=None):
+        self.config = config or TrainConfig()
+        self.module = (
+            get_model(module_or_name, num_classes=self.config.num_classes)
+            if isinstance(module_or_name, str)
+            else module_or_name
+        )
+        self.mesh = mesh
+        self._compiled = False
+        self._state: Optional[TrainState] = None
+
+    def compile(
+        self,
+        optimizer: str = "sgd",
+        loss: str = "sparse_categorical_crossentropy",
+        metrics: Sequence[str] = ("accuracy",),
+    ) -> "Model":
+        """Record compile-time choices. The actual optax transformation is
+        built at ``fit`` time when steps_per_epoch and schedule-affecting
+        callbacks are known (the reference builds its optimizer at
+        ``:155-166`` and layers warmup/decay on via callbacks later —
+        same information, one construction point here)."""
+        if optimizer not in ("sgd", "momentum"):
+            raise ValueError(f"unsupported optimizer {optimizer!r} (have sgd)")
+        if loss != "sparse_categorical_crossentropy":
+            raise ValueError(f"unsupported loss {loss!r}")
+        self._compiled = True
+        return self
+
+    def fit(
+        self,
+        data: engine.EpochDataset,
+        epochs: Optional[int] = None,
+        callbacks: Sequence[Callback] = (),
+        validation_data: Optional[engine.EpochDataset] = None,
+        initial_epoch: int = 0,
+    ) -> engine.FitResult:
+        if not self._compiled:
+            raise RuntimeError("call compile() before fit()")
+        cfg = self.config
+        # Consume declarative schedule callbacks (reference :211-224).
+        warmups = [c for c in callbacks if isinstance(c, LearningRateWarmupCallback)]
+        scheds = [c for c in callbacks if isinstance(c, LearningRateScheduleCallback)]
+        if warmups:
+            cfg = cfg.replace(warmup_epochs=warmups[0].warmup_epochs)
+        if scheds:
+            # Reference semantics (Horovod LearningRateScheduleCallback):
+            # each callback's multiplier is ABSOLUTE w.r.t. the base LR
+            # from its start_epoch on. The compiled piecewise schedule
+            # multiplies factors cumulatively, so convert: per-boundary
+            # factor = this multiplier / previous multiplier.
+            ordered = sorted(scheds, key=lambda c: c.start_epoch)
+            decay_epochs = tuple(c.start_epoch for c in ordered)
+            mults = [c.multiplier for c in ordered]
+            ratios = tuple(
+                m / (mults[i - 1] if i else 1.0) for i, m in enumerate(mults)
+            )
+            cfg = cfg.replace(
+                lr_decay_epochs=decay_epochs, lr_decay_factors=ratios
+            )
+        tx, self.lr_schedule = create_optimizer(cfg, data.steps_per_epoch)
+        result = engine.fit(
+            self.module,
+            cfg,
+            data,
+            mesh=self.mesh,
+            tx=tx,
+            epochs=epochs,
+            callbacks=callbacks,
+            eval_data=validation_data,
+            state=self._state,
+        )
+        self._state = result.state
+        self.config = cfg
+        return result
+
+    def evaluate(self, data: engine.EpochDataset) -> Dict[str, float]:
+        if self._state is None:
+            raise RuntimeError("fit() (or load) before evaluate()")
+        return engine.evaluate(
+            self.module, self.config, data, self._state, mesh=self.mesh
+        )
+
+    def save_weights(self, directory: str, epoch: int = 0) -> None:
+        from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(directory)
+        mgr.save(epoch, self._state, force=True)
+        mgr.close()
+
+    def load_weights(self, directory: str) -> "Model":
+        from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+        from distributeddeeplearning_tpu.training.optimizer import create_optimizer
+        from distributeddeeplearning_tpu.training.train_step import (
+            create_train_state,
+            replicate_state,
+        )
+        from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+
+        if self._state is None:
+            tx, _ = create_optimizer(self.config, steps_per_epoch=1)
+            state = create_train_state(self.module, self.config, tx)
+            self._state = replicate_state(
+                state, self.mesh if self.mesh is not None else data_parallel_mesh()
+            )
+        mgr = CheckpointManager(directory)
+        self._state, _ = mgr.maybe_restore(self._state)
+        mgr.close()
+        return self
+
+    @property
+    def state(self) -> Optional[TrainState]:
+        return self._state

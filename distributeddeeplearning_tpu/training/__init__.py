@@ -6,6 +6,9 @@ from distributeddeeplearning_tpu.training.train_step import (
     make_train_step,
     make_eval_step,
 )
+from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+from distributeddeeplearning_tpu.training import callbacks
+from distributeddeeplearning_tpu.training.loop import fit, evaluate, FitResult
 
 __all__ = [
     "TrainState",
@@ -14,4 +17,9 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_eval_step",
+    "CheckpointManager",
+    "callbacks",
+    "fit",
+    "evaluate",
+    "FitResult",
 ]

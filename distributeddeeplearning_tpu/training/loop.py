@@ -1,0 +1,214 @@
+"""The one training engine behind all three front-ends.
+
+The reference ships three parallel runtimes (tf.estimator's hidden loop,
+Keras ``fit_generator``, PyTorch's hand-written loop — SURVEY.md §3);
+here there is ONE engine and the front-ends are thin API skins (§7:
+"3 API styles over one runtime"). The engine owns: state init/resume,
+per-epoch iteration with device prefetch, the compiled train/eval steps,
+callbacks, checkpointing, and the canonical throughput summary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
+
+import jax
+import numpy as np
+import optax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device
+from distributeddeeplearning_tpu.parallel import collectives
+from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+from distributeddeeplearning_tpu.training.callbacks import (
+    Callback,
+    CallbackList,
+    LoggerCallback,
+)
+from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+from distributeddeeplearning_tpu.training.optimizer import create_optimizer
+from distributeddeeplearning_tpu.training.state import TrainState
+from distributeddeeplearning_tpu.training.train_step import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    replicate_state,
+)
+from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
+from distributeddeeplearning_tpu.utils.timer import Timer
+
+
+class EpochDataset(Protocol):
+    """The engine's dataset protocol (synthetic + ImageNet both satisfy it)."""
+
+    steps_per_epoch: int
+
+    def epoch(self, epoch_index: int) -> Iterable[Tuple[np.ndarray, np.ndarray]]: ...
+
+    def __len__(self) -> int: ...
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: TrainState
+    history: List[Dict[str, float]]
+    images_per_sec: float
+
+
+def fit(
+    model,
+    config: TrainConfig,
+    train_data: EpochDataset,
+    *,
+    mesh=None,
+    tx: Optional[optax.GradientTransformation] = None,
+    epochs: Optional[int] = None,
+    callbacks: Sequence[Callback] = (),
+    eval_data: Optional[EpochDataset] = None,
+    checkpoint_manager: Optional[CheckpointManager] = None,
+    add_default_logger: bool = True,
+    state: Optional[TrainState] = None,
+) -> FitResult:
+    """Train ``model`` for ``epochs`` over ``train_data`` on ``mesh``.
+
+    Mirrors, in one place, the reference's three mainlines: builds state
+    (deterministic seeded init ≙ broadcast), resumes from checkpoint if
+    present (Keras ``:323-341``), runs epochs with device-prefetched
+    batches, fires callbacks, optionally evaluates (metrics in-step
+    averaged, Keras ``:344-353``), and prints the ``_log_summary`` block.
+    """
+    log = get_logger()
+    mesh = mesh if mesh is not None else data_parallel_mesh()
+    epochs = epochs if epochs is not None else config.epochs
+    steps_per_epoch = train_data.steps_per_epoch
+
+    if tx is None:
+        tx, _ = create_optimizer(config, steps_per_epoch)
+    if state is None:
+        state = create_train_state(model, config, tx)
+    state = replicate_state(state, mesh)
+
+    from distributeddeeplearning_tpu.training.callbacks import (
+        ModelCheckpointCallback,
+    )
+
+    cbs = list(callbacks)
+    if add_default_logger and not any(isinstance(c, LoggerCallback) for c in cbs):
+        cbs.append(LoggerCallback())
+    callback_list = CallbackList(
+        cbs,
+        context={
+            "config": config,
+            "mesh": mesh,
+            "steps_per_epoch": steps_per_epoch,
+            "checkpoint_manager": checkpoint_manager,
+        },
+    )
+
+    # Exactly ONE orbax manager per directory: two managers saving the same
+    # step race/crash. Priority: explicit manager > the callback's manager
+    # (shared — engine resumes from it, callback saves to it) > auto from
+    # config.model_dir. The callback defers to context["checkpoint_manager"]
+    # so an explicit manager is shared too.
+    ckpt_cb = next(
+        (c for c in cbs if isinstance(c, ModelCheckpointCallback)), None
+    )
+    ckpt = checkpoint_manager
+    if ckpt is None and ckpt_cb is not None:
+        ckpt = ckpt_cb.manager()
+    if ckpt is None and config.model_dir:
+        ckpt = CheckpointManager(
+            config.model_dir, save_every_epochs=config.checkpoint_every_epochs
+        )
+    engine_saves = ckpt is not None and ckpt_cb is None
+
+    start_epoch = 0
+    if ckpt is not None and ckpt.enabled and config.resume:
+        state, start_epoch = ckpt.maybe_restore(state)
+        if start_epoch:
+            log.info("resuming from epoch %d", start_epoch)
+
+    train_step = make_train_step(model, tx, mesh, config)
+    eval_step = make_eval_step(model, mesh) if eval_data is not None else None
+
+    history: List[Dict[str, float]] = []
+    global_batch = config.global_batch_size
+    run_timer = Timer().start()
+    total_images = 0
+    callback_list.on_train_begin({"state": state})
+
+    metrics = {}
+    for epoch in range(start_epoch, epochs):
+        callback_list.on_epoch_begin(epoch)
+        step_in_epoch = 0
+        for batch in prefetch_to_device(
+            train_data.epoch(epoch), mesh, size=config.prefetch_batches
+        ):
+            state, metrics = train_step(state, batch)
+            step_in_epoch += 1
+            if (
+                config.log_every_steps
+                and step_in_epoch % config.log_every_steps == 0
+            ):
+                callback_list.on_step_end(
+                    step_in_epoch, {"metrics": metrics, "state": state}
+                )
+        epoch_images = step_in_epoch * global_batch
+        total_images += epoch_images
+        # One host sync per epoch: materialise the last step's metrics.
+        epoch_logs: Dict[str, Any] = {
+            k: float(jax.device_get(v)) for k, v in metrics.items()
+        }
+        epoch_logs["epoch_images"] = epoch_images
+
+        if eval_step is not None and eval_data is not None and config.validation:
+            eval_metrics = _run_eval(eval_step, state, eval_data, mesh, config)
+            epoch_logs.update({f"val_{k}": v for k, v in eval_metrics.items()})
+
+        history.append({k: v for k, v in epoch_logs.items() if k != "state"})
+        epoch_logs["state"] = state
+        callback_list.on_epoch_end(epoch, epoch_logs)
+        if engine_saves:
+            ckpt.save(epoch, state)
+
+    run_timer.stop()
+    callback_list.on_train_end({"state": state})
+    if ckpt is not None:
+        ckpt.wait()
+
+    images_per_sec = log_summary(
+        data_length=total_images,
+        duration_s=run_timer.elapsed,
+        batch_size_per_device=config.batch_size_per_device,
+        num_devices=jax.device_count(),
+        dataset_kind="synthetic" if config.fake else "real",
+    )
+    return FitResult(state=state, history=history, images_per_sec=images_per_sec)
+
+
+def _run_eval(eval_step, state, eval_data, mesh, config) -> Dict[str, float]:
+    totals: Dict[str, float] = {}
+    n = 0
+    for batch in prefetch_to_device(
+        eval_data.epoch(0), mesh, size=config.prefetch_batches
+    ):
+        m = eval_step(state, batch)
+        for k, v in m.items():
+            totals[k] = totals.get(k, 0.0) + float(jax.device_get(v))
+        n += 1
+    return {k: v / max(n, 1) for k, v in totals.items()}
+
+
+def evaluate(
+    model,
+    config: TrainConfig,
+    eval_data: EpochDataset,
+    state: TrainState,
+    *,
+    mesh=None,
+) -> Dict[str, float]:
+    """Standalone evaluation (reference ``validate()`` PyTorch ``:224-239``)."""
+    mesh = mesh if mesh is not None else data_parallel_mesh()
+    eval_step = make_eval_step(model, mesh)
+    return _run_eval(eval_step, state, eval_data, mesh, config)

@@ -36,13 +36,22 @@ def create_lr_schedule(
     peak = config.base_lr * (world_size if config.scale_lr_by_world_size else 1)
     warmup_steps = config.warmup_epochs * steps_per_epoch
 
+    factors = config.lr_decay_factors or (
+        (config.lr_decay_factor,) * len(config.lr_decay_epochs)
+    )
+    if len(factors) != len(config.lr_decay_epochs):
+        raise ValueError(
+            f"lr_decay_factors {factors} must match lr_decay_epochs "
+            f"{config.lr_decay_epochs} in length"
+        )
+
     def decay_boundaries(offset: int):
         # join_schedules passes (step - warmup_steps) to the post-warmup
         # schedule, so boundaries must be pre-offset or decay would fire
         # warmup_epochs late (at 35/65/85 instead of 30/60/80).
         return {
-            int(e * steps_per_epoch) - offset: config.lr_decay_factor
-            for e in config.lr_decay_epochs
+            int(e * steps_per_epoch) - offset: f
+            for e, f in zip(config.lr_decay_epochs, factors)
             if int(e * steps_per_epoch) - offset > 0
         }
 

@@ -1,0 +1,44 @@
+"""Train ResNet50 on ImageNet or synthetic data — estimator-style front-end.
+
+TPU-native counterpart of the reference's
+``HorovodTF/src/imagenet_estimator_tf_horovod.py`` (459 LoC): same
+env-var contract (docstring there, :1-9 — ``DISTRIBUTED``, ``FAKE``,
+``FAKE_DATA_LENGTH``, ``EPOCHS``, ``VALIDATION``, ``AZ_BATCHAI_INPUT_
+TRAIN``/``_TEST``, ``AZ_BATCHAI_OUTPUT_MODEL``), same mainline shape
+(main() :413-455), one engine underneath.
+
+Run locally (the reference's ``mpirun -np 2`` smoke, SURVEY.md §4.2)::
+
+    FAKE=True FAKE_DATA_LENGTH=2048 EPOCHS=1 BATCHSIZE=32 \
+        python examples/imagenet_estimator_tpu.py
+
+On a TPU pod slice, launch with ``python -m distributeddeeplearning_tpu.
+launch`` on every host (or let your job scheduler do it) — same script.
+"""
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data import make_input_fn
+from distributeddeeplearning_tpu.frontends import Estimator, RunConfig
+from distributeddeeplearning_tpu.parallel import distributed
+from distributeddeeplearning_tpu.utils.logging import get_logger
+
+
+def main():
+    distributed.maybe_initialize()  # hvd.init() equivalent (:417)
+    config = TrainConfig.from_env(model="resnet50")
+    logger = get_logger()
+    logger.info("Estimator-style training: %s", config)
+
+    estimator = Estimator(
+        config.model,
+        config,
+        RunConfig(model_dir=config.model_dir),
+    )
+    estimator.train(make_input_fn(train=True), epochs=config.epochs)
+    if config.validation:
+        metrics = estimator.evaluate(make_input_fn(train=False))
+        logger.info("validation: %s", metrics)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Train ResNet50 — explicit-loop front-end (you own the loop).
+
+TPU-native counterpart of the reference's
+``HorovodPytorch/src/imagenet_pytorch_horovod.py`` (363 LoC): the
+hand-written epoch loop (main() :267-359, train() :204-221, validate()
+:224-239), with checkpointing added — the reference PyTorch path has
+none (SURVEY.md §5), which we treat as a defect, not a feature.
+
+Run locally::
+
+    FAKE=True FAKE_DATA_LENGTH=2048 EPOCHS=1 BATCHSIZE=32 \
+        python examples/imagenet_explicit_tpu.py
+"""
+
+import jax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data import make_dataset
+from distributeddeeplearning_tpu.frontends import explicit
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.parallel import distributed
+from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
+from distributeddeeplearning_tpu.utils.timer import Timer
+
+
+def main():
+    distributed.maybe_initialize()
+    config = TrainConfig.from_env(model="resnet50")
+    logger = get_logger()
+    logger.info("explicit-loop training: %s", config)
+
+    model = get_model(config.model, num_classes=config.num_classes)
+    train_data = make_dataset(config, train=True)
+    pieces, state = explicit.setup(
+        model, config, steps_per_epoch=train_data.steps_per_epoch
+    )
+    ckpt = CheckpointManager(
+        config.model_dir, save_every_epochs=config.checkpoint_every_epochs
+    )
+    if config.resume and ckpt.enabled:
+        state, start_epoch = ckpt.maybe_restore(state)
+    else:
+        start_epoch = 0
+
+    timer = Timer().start()
+    for epoch in range(start_epoch, config.epochs):
+        state = explicit.train_epoch(pieces, state, train_data, epoch)
+        if config.validation:
+            metrics = explicit.validate(
+                pieces, state, make_dataset(config, train=False)
+            )
+            logger.info("validation: %s", metrics, extra={"epoch": epoch})
+        ckpt.save(epoch, state)
+    timer.stop()
+    ckpt.wait()
+
+    epochs_run = config.epochs - start_epoch
+    log_summary(
+        data_length=epochs_run * train_data.steps_per_epoch * config.global_batch_size,
+        duration_s=timer.elapsed,
+        batch_size_per_device=config.batch_size_per_device,
+        num_devices=jax.device_count(),
+        dataset_kind="synthetic" if config.fake else "real",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -52,3 +52,22 @@ def test_decay_epoch_inside_warmup_is_dropped():
     s = _sched(warmup_epochs=40, lr_decay_epochs=(30, 60))
     assert np.isclose(float(s(41 * SPE)), 0.008)  # 30-epoch decay dropped
     assert np.isclose(float(s(60 * SPE)), 0.0008)
+
+
+def test_absolute_multiplier_factors():
+    # Per-boundary factors: absolute multipliers 0.1 then 0.05 of base
+    # require ratios (0.1, 0.5).
+    cfg = TrainConfig(
+        lr_decay_epochs=(30, 60), lr_decay_factors=(0.1, 0.5), warmup_epochs=0
+    )
+    s = create_lr_schedule(cfg, SPE, world_size=1)
+    assert np.isclose(float(s(30 * SPE)), 0.001 * 0.1)
+    assert np.isclose(float(s(60 * SPE)), 0.001 * 0.05)
+
+
+def test_mismatched_factors_raise():
+    import pytest
+
+    cfg = TrainConfig(lr_decay_epochs=(30, 60), lr_decay_factors=(0.1,))
+    with pytest.raises(ValueError, match="lr_decay_factors"):
+        create_lr_schedule(cfg, SPE, world_size=1)
