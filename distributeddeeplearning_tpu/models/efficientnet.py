@@ -1,0 +1,196 @@
+"""EfficientNet family (B0-B7) via compound scaling.
+
+Not in the reference; required by BASELINE.json ("EfficientNet-B4 on
+ImageNet — stress input pipeline + larger activations, v5e-64").
+Standard architecture (MBConv + squeeze-excite + swish, BN momentum .9);
+TPU-first choices as elsewhere: NHWC, bf16 compute / f32 params+stats,
+static shapes, depthwise convs via ``feature_group_count`` which XLA:TPU
+lowers efficiently.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (width_mult, depth_mult, resolution, dropout)
+_SCALING = {
+    "b0": (1.0, 1.0, 224, 0.2),
+    "b1": (1.0, 1.1, 240, 0.2),
+    "b2": (1.1, 1.2, 260, 0.3),
+    "b3": (1.2, 1.4, 300, 0.3),
+    "b4": (1.4, 1.8, 380, 0.4),
+    "b5": (1.6, 2.2, 456, 0.4),
+    "b6": (1.8, 2.6, 528, 0.5),
+    "b7": (2.0, 3.1, 600, 0.5),
+}
+
+# Base (B0) stage config: (expand, channels, layers, stride, kernel)
+_BASE_STAGES = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+_KERNEL_INIT = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+
+
+def _round_filters(filters: int, width_mult: float, divisor: int = 8) -> int:
+    filters *= width_mult
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:
+        new += divisor
+    return int(new)
+
+
+def _round_repeats(repeats: int, depth_mult: float) -> int:
+    return int(math.ceil(depth_mult * repeats))
+
+
+def _bn(train, dtype, name=None):
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-3,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        name=name,
+    )
+
+
+class SqueezeExcite(nn.Module):
+    reduced: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.reduced, (1, 1), dtype=self.dtype, param_dtype=jnp.float32,
+                    kernel_init=_KERNEL_INIT, name="reduce")(s)
+        s = nn.swish(s)
+        s = nn.Conv(c, (1, 1), dtype=self.dtype, param_dtype=jnp.float32,
+                    kernel_init=_KERNEL_INIT, name="expand")(s)
+        return x * nn.sigmoid(s)
+
+
+class MBConv(nn.Module):
+    expand_ratio: int
+    out_channels: int
+    stride: int
+    kernel: int
+    se_ratio: float = 0.25
+    dtype: Any = jnp.bfloat16
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        in_c = x.shape[-1]
+        residual = x
+        mid = in_c * self.expand_ratio
+        if self.expand_ratio != 1:
+            x = nn.Conv(mid, (1, 1), use_bias=False, dtype=self.dtype,
+                        param_dtype=jnp.float32, kernel_init=_KERNEL_INIT,
+                        name="expand_conv")(x)
+            x = _bn(train, self.dtype, "expand_bn")(x)
+            x = nn.swish(x)
+        # depthwise
+        x = nn.Conv(
+            mid,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=mid,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=_KERNEL_INIT,
+            name="dw_conv",
+        )(x)
+        x = _bn(train, self.dtype, "dw_bn")(x)
+        x = nn.swish(x)
+        if self.se_ratio > 0:
+            x = SqueezeExcite(max(1, int(in_c * self.se_ratio)), self.dtype,
+                              name="se")(x)
+        x = nn.Conv(self.out_channels, (1, 1), use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, kernel_init=_KERNEL_INIT,
+                    name="project_conv")(x)
+        x = _bn(train, self.dtype, "project_bn")(x)
+        if self.stride == 1 and in_c == self.out_channels:
+            if self.drop_rate > 0:
+                # stochastic depth (per-sample drop-path)
+                x = nn.Dropout(
+                    self.drop_rate,
+                    broadcast_dims=(1, 2, 3),
+                    deterministic=not train,
+                )(x)
+            x = x + residual
+        return x
+
+
+class EfficientNet(nn.Module):
+    variant: str = "b4"
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    survival_prob: float = 0.8
+
+    @property
+    def default_image_size(self) -> int:
+        return _SCALING[self.variant][2]
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.variant not in _SCALING:
+            raise ValueError(f"variant must be one of {sorted(_SCALING)}")
+        width, depth, _, dropout = _SCALING[self.variant]
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(_round_filters(32, width), (3, 3), strides=(2, 2),
+                    padding=[(1, 1), (1, 1)], use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, kernel_init=_KERNEL_INIT,
+                    name="stem_conv")(x)
+        x = _bn(train, self.dtype, "stem_bn")(x)
+        x = nn.swish(x)
+
+        total_blocks = sum(_round_repeats(r, depth) for _, _, r, _, _ in _BASE_STAGES)
+        block_idx = 0
+        for stage, (expand, channels, repeats, stride, kernel) in enumerate(
+            _BASE_STAGES
+        ):
+            out_c = _round_filters(channels, width)
+            for i in range(_round_repeats(repeats, depth)):
+                drop = (1 - self.survival_prob) * block_idx / total_blocks
+                x = MBConv(
+                    expand_ratio=expand,
+                    out_channels=out_c,
+                    stride=stride if i == 0 else 1,
+                    kernel=kernel,
+                    dtype=self.dtype,
+                    drop_rate=drop,
+                    name=f"stage{stage + 1}_block{i + 1}",
+                )(x, train)
+                block_idx += 1
+
+        x = nn.Conv(_round_filters(1280, width), (1, 1), use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    kernel_init=_KERNEL_INIT, name="head_conv")(x)
+        x = _bn(train, self.dtype, "head_bn")(x)
+        x = nn.swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        if dropout > 0:
+            x = nn.Dropout(dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="head")(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+EfficientNetB0 = functools.partial(EfficientNet, variant="b0")
+EfficientNetB4 = functools.partial(EfficientNet, variant="b4")
+EfficientNetB7 = functools.partial(EfficientNet, variant="b7")

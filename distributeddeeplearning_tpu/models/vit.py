@@ -1,0 +1,207 @@
+"""Vision Transformer (ViT) family with tensor-parallel sharding annotations.
+
+Not in the reference (vision-conv only); required by BASELINE.json's
+configs ("ViT-B/16 on ImageNet — non-conv allreduce workload, v5e-64").
+Design is TPU-first throughout:
+
+* Every weight is annotated with **logical axes** via
+  ``nn.with_logical_partitioning``; ``models.vit.LOGICAL_RULES`` maps
+  them onto mesh axes so the same module runs pure-DP (rules map model
+  dims to None) or tensor-parallel (attention heads + MLP hidden sharded
+  over ``model``) without touching the module. The pjit engine
+  (``training/pjit_step.py``) consumes these annotations.
+* Attention goes through ``ops.dot_product_attention`` so the impl can
+  be swapped (XLA einsum / Pallas flash kernel / ring sequence-parallel)
+  per config.
+* bf16 compute, f32 params; LayerNorm in f32 (TPU numerics practice).
+
+Variant table follows the standard ViT paper sizes; patch size via name
+suffix (``vit_b16`` = Base, 16x16 patches).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.ops.attention import dot_product_attention
+
+# name -> (hidden, depth, heads, mlp_dim)
+_VARIANTS = {
+    "ti": (192, 12, 3, 768),
+    "s": (384, 12, 6, 1536),
+    "b": (768, 12, 12, 3072),
+    "l": (1024, 24, 16, 4096),
+    "h": (1280, 32, 16, 5120),
+}
+
+# Logical-axis -> mesh-axis rules. The pjit engine passes these to
+# nn.logical_to_mesh_sharding. "model"-mapped dims give Megatron-style TP:
+# column-parallel QKV/MLP-in, row-parallel proj/MLP-out (XLA inserts the
+# reduce-scatter/all-reduce pair from the shardings).
+LOGICAL_RULES = (
+    ("batch", ("replica", "data")),
+    ("seq", None),  # sequence axis sharding is handled by ring attention
+    ("embed", None),
+    ("heads", "model"),
+    ("head_dim", None),
+    ("mlp", "model"),
+    ("classes", None),
+)
+
+DATA_PARALLEL_RULES = tuple(
+    (name, ("replica", "data") if name == "batch" else None)
+    for name, _ in LOGICAL_RULES
+)
+
+
+def _dense(features, name, kernel_axes, dtype, use_bias=True):
+    return nn.Dense(
+        features,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        use_bias=use_bias,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), kernel_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, (kernel_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = x.shape[-1]
+        x = _dense(self.mlp_dim, "fc1", ("embed", "mlp"), self.dtype)(x)
+        x = nn.gelu(x)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = _dense(d, "fc2", ("mlp", "embed"), self.dtype)(x)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x
+
+
+class Attention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        qkv = _dense(3 * d, "qkv", ("embed", "heads"), self.dtype)(x)
+        qkv = qkv.reshape(*x.shape[:-1], 3, self.num_heads, head_dim)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        out = dot_product_attention(q, k, v, impl=self.attn_impl)
+        out = out.reshape(*x.shape[:-1], d)
+        out = _dense(d, "proj", ("heads", "embed"), self.dtype)(out)
+        if self.dropout > 0:
+            out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        return out
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # Pre-norm; LayerNorm in f32 for stable statistics under bf16.
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        x = x + Attention(
+            self.num_heads, self.dtype, self.attn_impl, self.dropout, name="attn"
+        )(y, train)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        x = x + MlpBlock(self.mlp_dim, self.dtype, self.dropout, name="mlp")(y, train)
+        return x
+
+
+class ViT(nn.Module):
+    """ViT with a classification head (cls-token pooling)."""
+
+    variant: str = "b"
+    patch_size: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.variant not in _VARIANTS:
+            raise ValueError(f"variant must be one of {sorted(_VARIANTS)}")
+        hidden, depth, heads, mlp_dim = _VARIANTS[self.variant]
+        b, h, w, _ = x.shape
+        if h % self.patch_size or w % self.patch_size:
+            raise ValueError(
+                f"image size {h}x{w} not divisible by patch {self.patch_size}"
+            )
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(
+            hidden,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), (None, None, None, "embed")
+            ),
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, hidden)
+        n_tokens = x.shape[1]
+
+        cls = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(nn.initializers.zeros, (None, None, "embed")),
+            (1, 1, hidden),
+            jnp.float32,
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "seq", "embed")
+            ),
+            (1, n_tokens + 1, hidden),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        for i in range(depth):
+            x = EncoderBlock(
+                heads,
+                mlp_dim,
+                self.dtype,
+                self.attn_impl,
+                self.dropout,
+                name=f"block{i}",
+            )(x, train)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        x = x[:, 0]  # cls token
+        x = _dense(self.num_classes, "head", ("embed", "classes"), jnp.float32)(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+ViT_B16 = functools.partial(ViT, variant="b", patch_size=16)
+ViT_S16 = functools.partial(ViT, variant="s", patch_size=16)
+ViT_Ti16 = functools.partial(ViT, variant="ti", patch_size=16)
+ViT_L16 = functools.partial(ViT, variant="l", patch_size=16)

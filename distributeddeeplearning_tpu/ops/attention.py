@@ -1,0 +1,74 @@
+"""Attention ops with pluggable implementations.
+
+The reference has no attention anywhere (vision-only, SURVEY.md §2b) —
+this op layer exists because the BASELINE.json configs add ViT-B/16 and
+because long-context support is first-class in this framework. One
+signature, three implementations:
+
+* ``xla``   — einsum softmax attention; XLA fuses it well for moderate T.
+* ``pallas`` — fused flash-attention TPU kernel (``ops/pallas/flash.py``)
+  for long T where materialising the [T, T] score matrix would blow HBM.
+* ``ring``  — sequence-parallel blockwise attention over a ``seq`` mesh
+  axis (``parallel/ring_attention.py``): K/V blocks rotate around the
+  ring via ``ppermute`` while each shard holds only T/n of the sequence.
+
+All take ``[batch, seq, heads, head_dim]`` (BTHD) tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _xla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "xla",
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Multi-head attention over BTHD tensors.
+
+    ``impl='ring'`` requires running inside ``shard_map`` with the
+    sequence dimension sharded over ``axis_name``.
+    """
+    if impl == "xla":
+        return _xla_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "pallas":
+        from distributeddeeplearning_tpu.ops.pallas.flash import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "ring":
+        if axis_name is None:
+            raise ValueError("impl='ring' requires axis_name of the seq mesh axis")
+        from distributeddeeplearning_tpu.parallel.ring_attention import (
+            ring_attention,
+        )
+
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")
