@@ -12,6 +12,7 @@ from typing import Any, Callable, Dict
 
 import jax.numpy as jnp
 
+from distributeddeeplearning_tpu.models.efficientnet import EfficientNet
 from distributeddeeplearning_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -22,6 +23,7 @@ from distributeddeeplearning_tpu.models.resnet import (
     ResNet200,
     resnet_v1,
 )
+from distributeddeeplearning_tpu.models.vit import ViT
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
 
@@ -49,7 +51,26 @@ for _depth in (18, 34, 50, 101, 152, 200):
             depth=d, num_classes=num_classes, dtype=dtype, **kw)))(_depth),
     )
 
+# ViT family (BASELINE.json config: ViT-B/16). Name = vit_<variant><patch>.
+for _variant in ("ti", "s", "b", "l", "h"):
+    register_model(
+        f"vit_{_variant}16",
+        (lambda v: (lambda num_classes=1000, dtype=jnp.bfloat16, **kw: ViT(
+            variant=v, patch_size=16, num_classes=num_classes, dtype=dtype,
+            **kw)))(_variant),
+    )
+
+# EfficientNet family (BASELINE.json config: EfficientNet-B4).
+for _b in range(8):
+    register_model(
+        f"efficientnet_b{_b}",
+        (lambda v: (lambda num_classes=1000, dtype=jnp.bfloat16, **kw: EfficientNet(
+            variant=v, num_classes=num_classes, dtype=dtype, **kw)))(f"b{_b}"),
+    )
+
 __all__ = [
+    "EfficientNet",
+    "ViT",
     "ResNet",
     "ResNet18",
     "ResNet34",

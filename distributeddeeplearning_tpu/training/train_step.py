@@ -91,8 +91,14 @@ def create_train_state(
     variables = jax.jit(model.init, static_argnames=("train",))(
         rng, jnp.zeros(shape, jnp.float32), train=False
     )
+    # Unbox nn.with_logical_partitioning metadata (ViT): the DP engine
+    # replicates params, so the logical axes are dead weight here — and
+    # boxed leaves would hide the `kernel` path component from
+    # l2_kernel_penalty. The pjit engine keeps the boxes (pjit_step.py).
+    import flax.linen as nn
+
     return TrainState.create(
-        params=variables["params"],
+        params=nn.unbox(variables["params"]),
         batch_stats=variables.get("batch_stats", {}),
         tx=tx,
     )
@@ -116,9 +122,24 @@ def make_train_step(
     if not axes:
         raise ValueError(f"mesh {mesh.axis_names} has no batch axis")
     axis = axes if len(axes) > 1 else axes[0]
+    base_rng = jax.random.PRNGKey(cfg.seed)
+
+    def _device_index():
+        # Flat index of this shard across the batch axes (row-major).
+        idx = jnp.zeros((), jnp.int32)
+        for a in axes:
+            idx = idx * mesh.shape[a] + lax.axis_index(a)
+        return idx
 
     def local_step(state: TrainState, batch: Batch):
         images, labels = batch
+        # Per-step, per-device dropout key: stochastic models (EfficientNet
+        # drop-path/dropout, ViT with dropout>0) draw independent noise on
+        # every device and every step, like the reference's per-worker
+        # torch/keras RNG streams.
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step), _device_index()
+        )
         # Cast replicated params to device-varying before differentiating.
         # Without this, shard_map's vma transpose rule auto-inserts a psum
         # into the backward pass (grad w.r.t. an unvarying input sums over
@@ -135,10 +156,11 @@ def make_train_step(
                 images,
                 train=True,
                 mutable=["batch_stats"],
+                rngs={"dropout": dropout_rng},
             )
             loss = cross_entropy_loss(logits, labels, cfg.label_smoothing)
             loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
-            return loss, (logits, mutated["batch_stats"])
+            return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params_v

@@ -1,0 +1,115 @@
+"""EfficientNet family: registry reachability + real train steps.
+
+This is the VERDICT Weak-#1 regression suite: EfficientNet's stochastic
+depth (drop-path, on by default via survival_prob=0.8) and head dropout
+previously crashed make_train_step with flax InvalidRngError because no
+'dropout' rng was threaded. Every test here runs with stochasticity ON.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.pipeline import shard_batch
+from distributeddeeplearning_tpu.models import available_models, get_model
+from distributeddeeplearning_tpu.models.efficientnet import EfficientNet
+from distributeddeeplearning_tpu.training import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+CFG = TrainConfig(
+    model="efficientnet_b0",
+    num_classes=10,
+    image_size=32,
+    batch_size_per_device=2,
+    weight_decay=0.0,
+    compute_dtype="float32",
+)
+
+
+def _model():
+    # Defaults kept: survival_prob=0.8 => drop-path active, head dropout 0.2.
+    return EfficientNet(variant="b0", num_classes=10, dtype=jnp.float32)
+
+
+def _batch(global_batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.randn(global_batch, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=(global_batch,)).astype(np.int32)
+    return images, labels
+
+
+def test_registry_has_efficientnet_family():
+    names = available_models()
+    for b in range(8):
+        assert f"efficientnet_b{b}" in names
+    model = get_model("efficientnet_b4", num_classes=10)
+    assert isinstance(model, EfficientNet)
+    assert model.variant == "b4"
+    assert model.default_image_size == 380
+
+
+def test_efficientnet_b0_param_count():
+    # Canonical EfficientNet-B0 @1000 classes is ~5.29M params.
+    model = get_model("efficientnet_b0")
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, 224, 224, 3), jnp.float32), train=False),
+        jax.random.PRNGKey(0),
+    )
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes["params"]))
+    assert 5.0e6 < n < 5.6e6, n
+
+
+def test_efficientnet_trains_with_stochastic_depth(mesh8):
+    """survival_prob=0.8 default: the exact config that used to raise
+    InvalidRngError on step 1."""
+    model = _model()
+    tx = optax.sgd(0.05)
+    state = replicate_state(
+        create_train_state(model, CFG, tx, input_shape=(1, 32, 32, 3)), mesh8
+    )
+    step = make_train_step(model, tx, mesh8, CFG, donate_state=False)
+    batch = shard_batch(_batch(), mesh8)
+    state, metrics = step(state, batch)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["grad_norm"]) > 0.0
+
+
+def test_efficientnet_loss_decreases(mesh8):
+    # Per-device batch 8 (not 2): the deep stages run at 1x1 spatial, so
+    # per-replica BN variance over a 2-sample shard collapses and gradients
+    # explode — a shard-size artifact, not a model property. lr kept small
+    # for swish+SE on random data.
+    model = _model()
+    tx = optax.sgd(0.01)
+    cfg = CFG.replace(batch_size_per_device=8)
+    state = replicate_state(
+        create_train_state(model, cfg, tx, input_shape=(1, 32, 32, 3)), mesh8
+    )
+    step = make_train_step(model, tx, mesh8, cfg, donate_state=False)
+    batch = shard_batch(_batch(global_batch=64), mesh8)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_efficientnet_eval_deterministic(mesh8):
+    """Eval (train=False) needs no rng and is reproducible."""
+    model = _model()
+    tx = optax.sgd(0.05)
+    state = replicate_state(
+        create_train_state(model, CFG, tx, input_shape=(1, 32, 32, 3)), mesh8
+    )
+    eval_step = make_eval_step(model, mesh8)
+    batch = shard_batch(_batch(), mesh8)
+    a = float(eval_step(state, batch)["loss"])
+    b = float(eval_step(state, batch)["loss"])
+    assert a == b
