@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the framework's first-party "native tier".
+
+The reference's native tier is vendored CUDA/NCCL binaries (SURVEY.md
+§2a); on TPU the idiomatic equivalent is custom Pallas kernels for the
+ops where XLA's default lowering leaves performance on the table.
+"""
+
+from distributeddeeplearning_tpu.ops.pallas.flash import flash_attention
+
+__all__ = ["flash_attention"]

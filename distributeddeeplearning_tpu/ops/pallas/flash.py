@@ -1,0 +1,250 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The framework's native-tier attention (SURVEY.md §2a maps the
+reference's CUDA/NCCL tier to first-party Pallas kernels). The XLA
+einsum path (``ops/attention.py``) materialises the ``[T, T]`` score
+matrix in HBM; this kernel streams K/V blocks through VMEM with the
+online-softmax recurrence, so peak memory is ``O(T·d)`` and the scores
+never leave the chip:
+
+  forward : one grid program per (batch, head, q-block). Running
+            row-max ``m``, normaliser ``l`` and the f32 accumulator are
+            carried through a ``fori_loop`` over K blocks; the MXU sees
+            two matmuls per block (``q·kᵀ`` and ``p·v``).
+  backward: custom VJP using the saved per-row logsumexp, recomputed
+            blockwise in pure JAX (a ``lax.scan`` over K blocks) — the
+            standard flash-attention backward recurrence, also without
+            a ``[T, T]`` residual.
+
+On non-TPU backends the kernel runs in Pallas interpreter mode, so the
+CPU test mesh exercises the identical code path (§7 hard part (d)).
+
+Layout: inputs are BTHD ``[batch, seq, heads, head_dim]`` (the
+framework-wide attention layout, ``ops/attention.py``); internally the
+kernel works in BHTD so the last two dims tile onto (sublane, lane).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _flash_fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    *,
+    scale: float,
+    causal: bool,
+    block_k: int,
+    kv_len: int,
+):
+    """One (batch·head, q-block) program: stream K/V blocks, online softmax."""
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    block_q, d = q.shape
+    num_kb = k_ref.shape[1] // block_k
+    q_start = pl.program_id(1) * block_q
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+
+        # Mask K padding (and the causal future). Global indices:
+        k_idx = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_idx < kv_len
+        if causal:
+            q_idx = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = jnp.logical_and(mask, q_idx >= k_idx)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # Skip K blocks entirely in this q-block's masked future (~2x
+        # less MXU work for long causal T). Upper bound: blocks through
+        # the diagonal of the last q row in this block.
+        num_kb = jnp.minimum(
+            num_kb, lax.div(q_start + block_q + block_k - 1, block_k)
+        )
+    m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padded) q rows
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Core: BHTD tensors, padded lengths handled here."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    bq = min(block_q, _ceil_to(tq, 8))
+    bk = min(block_k, _ceil_to(tk, 8))
+    tq_p = _ceil_to(tq, bq)
+    tk_p = _ceil_to(tk, bk)
+    qp = jnp.pad(q, ((0, 0), (0, tq_p - tq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0)))
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_k=bk, kv_len=tk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, tq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk_p, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk_p, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :tq], lse[:, :tq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    """Blockwise flash backward (pure JAX): lax.scan over K blocks.
+
+    With p = exp(s − lse):  dv = pᵀ·do;  ds = p ⊙ (do·vᵀ − D) where
+    D = rowsum(do ⊙ o);  dq = Σ_blocks ds·k·scale;  dk = dsᵀ·q·scale.
+    Peak memory is O(T·block_k) per (b,h) — no [T, T] residual.
+    """
+    q, k, v, out, lse = res
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    bk = min(block_k, _ceil_to(tk, 8))
+    tk_p = _ceil_to(tk, bk)
+    nkb = tk_p // bk
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [bh, tq]
+
+    kp = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0))).astype(jnp.float32)
+    vp = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0))).astype(jnp.float32)
+    # [nkb, bh, bk, d] so scan walks K blocks.
+    k_blocks = kp.reshape(bh, nkb, bk, d).transpose(1, 0, 2, 3)
+    v_blocks = vp.reshape(bh, nkb, bk, d).transpose(1, 0, 2, 3)
+
+    q_idx = lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
+
+    def body(dq_acc, inp):
+        j, kb, vb = inp
+        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        k_idx = j * bk + lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
+        mask = k_idx < tk
+        if causal:
+            mask = jnp.logical_and(mask, q_idx >= k_idx)
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vb)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kb)
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk_b, dv_b)
+
+    dq0 = jnp.zeros((bh, tq, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        body, dq0, (jnp.arange(nkb), k_blocks, v_blocks)
+    )
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(bh, tk_p, d)[:, :tk]
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(bh, tk_p, d)[:, :tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention over BTHD ``[batch, seq, heads, head_dim]`` tensors.
+
+    Drop-in replacement for the XLA path (``dot_product_attention``
+    ``impl='xla'``): same signature, same output, O(T·d) memory. For
+    causal use, query and key lengths must match (self-attention).
+
+    ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
+    Pallas interpreter elsewhere (so tests on the CPU mesh run the same
+    kernel code).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected BTHD [b, t, h, d], got shape {q.shape}")
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError("causal flash attention requires equal q/k lengths")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    # BTHD -> BHTD, fold (b, h) into one grid axis.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    out = _flash_attention_bhtd(
+        qt, kt, vt, causal, float(scale), block_q, block_k, interpret
+    )
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -1,4 +1,5 @@
 from distributeddeeplearning_tpu.parallel.mesh import MeshConfig, create_mesh
 from distributeddeeplearning_tpu.parallel import collectives
+from distributeddeeplearning_tpu.parallel.ring_attention import ring_attention
 
-__all__ = ["MeshConfig", "create_mesh", "collectives"]
+__all__ = ["MeshConfig", "create_mesh", "collectives", "ring_attention"]

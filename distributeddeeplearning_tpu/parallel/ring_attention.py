@@ -1,0 +1,124 @@
+"""Ring attention: sequence-parallel attention over a ``seq`` mesh axis.
+
+Long-context tier of the framework (SURVEY.md §5 notes the reference
+never scales sequence length — it has no attention at all; this module
+is why the mesh reserves a ``seq`` axis, ``parallel/mesh.py``).
+
+Each device holds a ``T/n`` shard of Q, K and V. K/V shards rotate
+around the ring via ``lax.ppermute`` (XLA lowers neighbour permutes onto
+ICI links); every step each device computes blockwise attention of its
+resident Q shard against the visiting K/V shard and folds the result
+into the online-softmax state (running row-max ``m``, normaliser ``l``,
+f32 accumulator). After ``n`` steps every Q row has seen the full
+sequence while no device ever materialised more than a
+``[T/n, T/n]`` score block.
+
+Communication/compute overlap is XLA's job: the ``ppermute`` for step
+``s+1`` is independent of step ``s``'s matmuls, so the scheduler can
+overlap them (the classic ring-attention pipeline).
+
+Differentiable end-to-end: the whole ring is a ``lax.scan`` of pure ops
+plus ``ppermute`` (which has a transpose rule — the backward pass runs
+the ring in reverse), so ``jax.grad`` through ``shard_map`` works.
+
+Must be called INSIDE ``shard_map`` with Q/K/V's sequence dim sharded
+over ``axis_name``; causal masking uses global indices reconstructed
+from ``lax.axis_index``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention over local BTHD shards.
+
+    Args:
+      q, k, v: local shards ``[batch, T_local, heads, head_dim]`` with the
+        global sequence of length ``T_local * axis_size`` sharded over
+        ``axis_name`` in order (shard ``i`` holds tokens
+        ``[i*T_local, (i+1)*T_local)``).
+      axis_name: mesh axis the sequence is sharded over.
+      causal: apply a causal mask in *global* token coordinates.
+      scale: score scale; defaults to ``head_dim**-0.5``.
+
+    Returns the local output shard ``[batch, T_local, heads, head_dim]``.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected BTHD [b, t, h, d], got shape {q.shape}")
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    q_global = my * t_local + lax.broadcasted_iota(
+        jnp.int32, (t_local, t_local), 0
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        m_prev, l_prev, acc, kc, vc = carry
+        # The visiting shard originated on device (my - step) mod n.
+        src = (my - step) % n
+        s = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q32,
+                kc.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [b, h, t_local, t_local]
+        if causal:
+            k_global = src * t_local + lax.broadcasted_iota(
+                jnp.int32, (t_local, t_local), 1
+            )
+            s = jnp.where(q_global >= k_global, s, _NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd",
+            p,
+            vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # Rotate K/V to the next device. (The final rotation returns the
+        # shards home; XLA overlaps it with this step's matmuls.)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m_new, l_new, acc, kc, vc), None
+
+    # Mark the zero-init carries device-varying: they depend on nothing
+    # sharded yet, but the scan writes device-varying values into them.
+    m0 = lax.pcast(
+        jnp.full((b, h, t_local), _NEG_INF, jnp.float32), axis_name, to="varying"
+    )
+    l0 = lax.pcast(jnp.zeros((b, h, t_local), jnp.float32), axis_name, to="varying")
+    acc0 = lax.pcast(
+        jnp.zeros((b, h, t_local, d), jnp.float32), axis_name, to="varying"
+    )
+    (m, l, acc, _, _), _ = lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3)  # BHTD -> BTHD
+    return out.astype(q.dtype)

@@ -9,6 +9,11 @@ from distributeddeeplearning_tpu.training.train_step import (
 from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
 from distributeddeeplearning_tpu.training import callbacks
 from distributeddeeplearning_tpu.training.loop import fit, evaluate, FitResult
+from distributeddeeplearning_tpu.training.pjit_step import (
+    create_sharded_train_state,
+    make_pjit_train_step,
+    make_pjit_eval_step,
+)
 
 __all__ = [
     "TrainState",
@@ -22,4 +27,7 @@ __all__ = [
     "fit",
     "evaluate",
     "FitResult",
+    "create_sharded_train_state",
+    "make_pjit_train_step",
+    "make_pjit_eval_step",
 ]

@@ -1,0 +1,229 @@
+"""GSPMD (pjit) train-step engine — tensor/sequence-parallel path.
+
+The shard_map engine (``training/train_step.py``) is the reference-parity
+data-parallel runtime. This engine is the scale-up path the reference
+never had (its README names model parallelism as future work,
+``/root/reference/README.md:21``): models annotate weights with *logical*
+axes (``nn.with_logical_partitioning`` — see ``models/vit.py``), a rules
+table maps logical axes onto mesh axes (``models.vit.LOGICAL_RULES``),
+and XLA's SPMD partitioner inserts the collectives implied by the
+shardings — Megatron-style column/row-parallel matmuls become
+all-reduce / reduce-scatter pairs on ICI without any hand-written
+communication.
+
+How sharding flows:
+  1. ``logical_shardings`` eval_shapes ``model.init``, reads the logical
+     axis names off the boxed params, and maps them to ``NamedSharding``s
+     via ``nn.logical_to_mesh_sharding(rules)``.
+  2. ``create_sharded_train_state`` jit-initialises with a
+     ``with_sharding_constraint`` on params; the optimizer state is
+     created *from the constrained params* inside the same jit, so XLA
+     propagates the shardings into momentum/etc. — sharded params never
+     exist replicated, even transiently (critical for models that don't
+     fit one chip).
+  3. ``make_pjit_train_step`` is a plain ``jax.jit``: committed input
+     shardings (state from step 2, batch from ``shard_batch``) drive the
+     partitioner; gradients of a batch-sharded loss w.r.t.
+     replicated-or-sharded params come out correctly reduced — the
+     explicit ``pmean`` of the shard_map engine is implicit here.
+
+Same loss/metric semantics as the DP engine (one difference: BatchNorm
+under GSPMD computes *global*-batch statistics — sync-BN — whereas the
+shard_map engine keeps the reference's per-replica stats; the pjit path
+targets norm-free/LayerNorm models like ViT where they coincide).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.mesh import (
+    batch_sharding as _mesh_batch_sharding,
+)
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.training.state import TrainState
+from distributeddeeplearning_tpu.training.train_step import (
+    Batch,
+    cross_entropy_loss,
+    l2_kernel_penalty,
+)
+
+PyTree = Any
+
+# Default rules: every logical axis replicated except batch — pure DP,
+# any model, no annotations required.
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (("batch", ("replica", "data")),)
+
+
+def logical_shardings(
+    model,
+    mesh: Mesh,
+    rules: Sequence[Tuple[str, Any]],
+    input_shape: Tuple[int, ...],
+    rng: Optional[jax.Array] = None,
+) -> Tuple[PyTree, PyTree]:
+    """(abstract_variables, NamedSharding tree for ``params``).
+
+    Reads ``nn.with_logical_partitioning`` annotations off an abstract
+    init; unannotated params (ResNet et al.) come back fully replicated.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    abstract = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        rng,
+        jnp.zeros(input_shape, jnp.float32),
+    )
+    logical_spec = nn.get_partition_spec(abstract)
+    shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+    return abstract, shardings["params"]
+
+
+def create_sharded_train_state(
+    model,
+    config: TrainConfig,
+    tx,
+    mesh: Mesh,
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+    *,
+    input_shape: Optional[Tuple[int, ...]] = None,
+    rng: Optional[jax.Array] = None,
+) -> TrainState:
+    """Seeded init, sharded at birth (no replicated intermediate)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+    shape = input_shape or (1, config.image_size, config.image_size, 3)
+    _, param_shardings = logical_shardings(model, mesh, rules, shape, rng)
+
+    def init_fn(r):
+        variables = model.init(r, jnp.zeros(shape, jnp.float32), train=False)
+        params = lax.with_sharding_constraint(
+            nn.unbox(variables["params"]), param_shardings
+        )
+        state = TrainState.create(
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            tx=tx,
+        )
+        # XLA does NOT propagate the params constraint into tx.init's
+        # zeros_like leaves — momentum etc. would come out replicated and
+        # blow memory at TP scale. Constrain every params-shaped subtree
+        # of the optimizer state to the params shardings.
+        return state.replace(
+            opt_state=_constrain_params_like(
+                state.opt_state, params, param_shardings
+            )
+        )
+
+    with mesh:
+        return jax.jit(init_fn)(rng)
+
+
+def _constrain_params_like(opt_state, params, param_shardings):
+    """Apply ``param_shardings`` to every subtree of ``opt_state`` whose
+    pytree structure equals the params structure (optax momentum / EMA /
+    Adam moments all mirror it)."""
+    params_def = jax.tree_util.tree_structure(params)
+
+    def is_params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == params_def
+        except Exception:
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda sub: jax.tree.map(lax.with_sharding_constraint, sub, param_shardings)
+        if is_params_like(sub)
+        else sub,
+        opt_state,
+        is_leaf=is_params_like,
+    )
+
+
+def make_pjit_train_step(
+    model,
+    tx,
+    mesh: Mesh,
+    config: Optional[TrainConfig] = None,
+    *,
+    donate_state: bool = True,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Compiled GSPMD train step. Shardings ride in on the arguments
+    (committed state + batch), so the same function serves DP, TP and
+    DP×TP meshes."""
+    cfg = config or TrainConfig()
+    base_rng = jax.random.PRNGKey(cfg.seed)
+    batch_sharding = _mesh_batch_sharding(mesh)
+
+    def step(state: TrainState, batch: Batch):
+        images, labels = batch
+        # Bind the step to ITS mesh: a batch committed to a different
+        # mesh/layout errors here instead of silently resharding.
+        images = lax.with_sharding_constraint(images, batch_sharding)
+        labels = lax.with_sharding_constraint(labels, batch_sharding)
+        dropout_rng = jax.random.fold_in(base_rng, state.step)
+
+        def loss_fn(params):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": dropout_rng},
+            )
+            loss = cross_entropy_loss(logits, labels, cfg.label_smoothing)
+            loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+            return loss, (logits, mutated.get("batch_stats", {}))
+
+        (loss, (logits, new_bs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        metrics = {
+            "loss": loss,
+            "accuracy": accuracy,
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+
+
+def make_pjit_eval_step(
+    model, mesh: Mesh
+) -> Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]:
+    batch_sharding = _mesh_batch_sharding(mesh)
+
+    def eval_step(state: TrainState, batch: Batch):
+        images, labels = batch
+        images = lax.with_sharding_constraint(images, batch_sharding)
+        labels = lax.with_sharding_constraint(labels, batch_sharding)
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        loss = cross_entropy_loss(logits, labels)
+        top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        top5 = jnp.mean(
+            jnp.any(
+                jnp.argsort(logits, axis=-1)[:, -5:] == labels[:, None], axis=-1
+            ).astype(jnp.float32)
+        )
+        return {"loss": loss, "top1": top1, "top5": top5}
+
+    return jax.jit(eval_step)

@@ -91,10 +91,10 @@ def create_train_state(
     variables = jax.jit(model.init, static_argnames=("train",))(
         rng, jnp.zeros(shape, jnp.float32), train=False
     )
-    # Unbox nn.with_logical_partitioning metadata (ViT): the DP engine
-    # replicates params, so the logical axes are dead weight here — and
-    # boxed leaves would hide the `kernel` path component from
-    # l2_kernel_penalty. The pjit engine keeps the boxes (pjit_step.py).
+    # Unbox nn.with_logical_partitioning metadata: boxed leaves would hide
+    # the `kernel` path component from l2_kernel_penalty. Both engines
+    # unbox — the pjit engine reads the logical axes off an eval_shape
+    # BEFORE unboxing (pjit_step.logical_shardings), never from the state.
     import flax.linen as nn
 
     return TrainState.create(
