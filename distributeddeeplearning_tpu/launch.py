@@ -1,0 +1,368 @@
+"""Multi-process job launcher — the mpirun / Batch-AI-submit equivalent.
+
+The reference starts every distributed run from outside the trainer:
+
+* locally, ``mpirun -np 2 -H localhost:2 python -u <script>`` inside the
+  framework container (``Horovod*/00_CreateImageAndTest.ipynb`` cells
+  6-7, SURVEY.md §3.4) — the pre-cluster smoke test;
+* on the cluster, a Batch AI job whose ``commandLine`` is
+  ``mpirun --hostfile $AZ_BATCHAI_MPI_HOST_FILE -x NCCL_* -x
+  DISTRIBUTED=True … python -u <script>`` (``01_Train*.ipynb`` cell 15),
+  with stdout/stderr streamed back (cells 25-26).
+
+TPU-native redesign — no MPI, no SSH rendezvous:
+
+* **local mode** forks N python processes on this host and wires the
+  gRPC-rendezvous contract ``parallel/distributed.maybe_initialize``
+  consumes: ``DDL_COORDINATOR`` (process 0's host:port),
+  ``DDL_NUM_PROCESSES``, ``DDL_PROCESS_ID``. Env propagation (mpirun's
+  ``-x``) is ``--env KEY=VALUE``; rank-tagged log streaming (mpirun
+  ``--tag-output`` / ``az batchai job file stream``) is built in. With
+  ``--platform cpu --devices-per-process K`` the same code path runs on
+  forced host devices — the reference's 2-process smoke test, no
+  hardware needed.
+* **pod mode** (``--tpu NAME``) wraps
+  ``gcloud compute tpus tpu-vm ssh NAME --worker=all --command=…`` —
+  every TPU-VM worker runs the same script and
+  ``jax.distributed.initialize()`` autodetects the pod topology from
+  TPU metadata, so no DDL_* vars are needed; we export
+  ``DISTRIBUTED=True`` (the reference's own flag) to request it.
+
+Usage::
+
+    # reference: mpirun -np 2 -H localhost:2 python -u script.py
+    python launch.py --num-processes 2 [--devices-per-process 4]
+        [--platform cpu] [--env FAKE=True] script.py [args…]
+
+    # reference: az batchai job create (01_Train*.ipynb cell 19)
+    python launch.py --tpu v5e-pod --zone us-west4-a
+        [--env FAKE=True] script.py [args…]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+\s*")
+
+
+def find_free_port() -> int:
+    """Pick a free TCP port for the process-0 coordination service."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_env_args(pairs: Sequence[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(f"--env expects KEY=VALUE, got {p!r}")
+        k, v = p.split("=", 1)
+        out[k] = v
+    return out
+
+
+def _child_env(
+    base: Dict[str, str],
+    *,
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    platform: Optional[str],
+    devices_per_process: Optional[int],
+    extra_env: Optional[Dict[str, str]],
+) -> Dict[str, str]:
+    env = dict(base)
+    env.update(extra_env or {})
+    # python sets sys.path[0] to the *script's* dir, so a child started as
+    # `python tests/foo.py` can't import the framework package; put the
+    # package's own root and the launch cwd first (the reference's
+    # PYTHONPATH=/workspace/common move, 00_CreateImageAndTest.ipynb cell
+    # 7). The package root keeps imports working when launching from any
+    # directory of an uninstalled source checkout.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg_root, os.getcwd(), env.get("PYTHONPATH")]
+    env["PYTHONPATH"] = os.pathsep.join(
+        dict.fromkeys(p for p in paths if p)  # de-dup, order-preserving
+    )
+    env["DDL_COORDINATOR"] = coordinator
+    env["DDL_NUM_PROCESSES"] = str(num_processes)
+    env["DDL_PROCESS_ID"] = str(process_id)
+    if platform:
+        # JAX_PLATFORMS alone is not enough when a TPU plugin force-sets
+        # jax_platforms at import; maybe_initialize re-applies DDL_PLATFORM
+        # via jax.config before touching the backend.
+        env["JAX_PLATFORMS"] = platform
+        env["DDL_PLATFORM"] = platform
+    if devices_per_process is not None:
+        flags = _DEVCOUNT_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={devices_per_process}"
+        ).strip()
+    return env
+
+
+def _stream(proc: subprocess.Popen, rank: int, tag: bool, sink) -> threading.Thread:
+    """Pump one child's merged stdout/stderr to ``sink``, rank-tagged.
+
+    The log-streaming role of ``az batchai job file stream … stdout.txt``
+    (``01_Train*.ipynb`` cells 25-26) and mpirun ``--tag-output``.
+    """
+
+    def pump():
+        prefix = f"[{rank}] " if tag else ""
+        for line in proc.stdout:  # type: ignore[union-attr]
+            sink.write(prefix + line)
+            sink.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def launch_local(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    num_processes: int = 2,
+    devices_per_process: Optional[int] = None,
+    platform: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    tag_output: bool = True,
+    timeout: Optional[float] = None,
+    sink=None,
+) -> int:
+    """Run ``script`` in ``num_processes`` local python processes.
+
+    Returns the first nonzero child exit code, or 0. On any child
+    failure (or timeout) the remaining children are terminated — the
+    all-or-nothing semantics of an mpirun world.
+    """
+    sink = sink or sys.stdout
+    coordinator = f"127.0.0.1:{find_free_port()}"
+    procs: List[subprocess.Popen] = []
+    pumps: List[threading.Thread] = []
+    for pid in range(num_processes):
+        cenv = _child_env(
+            dict(os.environ),
+            coordinator=coordinator,
+            num_processes=num_processes,
+            process_id=pid,
+            platform=platform,
+            devices_per_process=devices_per_process,
+            extra_env=env,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-u", script, *script_args],
+                env=cenv,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+        pumps.append(_stream(procs[-1], pid, tag_output, sink))
+
+    deadline = time.monotonic() + timeout if timeout else None
+    exit_code = 0
+    live = set(range(num_processes))
+    try:
+        while live:
+            for pid in sorted(live):
+                rc = procs[pid].poll()
+                if rc is not None:
+                    live.discard(pid)
+                    if rc != 0 and exit_code == 0:
+                        exit_code = rc
+                        sink.write(
+                            f"launch: process {pid} exited {rc}; "
+                            "terminating the job\n"
+                        )
+                        raise _ChildFailed()
+            if deadline and time.monotonic() > deadline:
+                sink.write(f"launch: timeout after {timeout}s; terminating\n")
+                exit_code = 124
+                raise _ChildFailed()
+            time.sleep(0.1)
+    except (_ChildFailed, KeyboardInterrupt):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        t_end = time.monotonic() + 10
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, t_end - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        if exit_code == 0:
+            exit_code = 130
+    finally:
+        for t in pumps:
+            t.join(timeout=5)
+    return exit_code
+
+
+class _ChildFailed(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# TPU pod mode (job submission — 01_Train*.ipynb cell 15/19 equivalent)
+# ---------------------------------------------------------------------------
+
+def build_pod_command(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    tpu: str,
+    zone: str,
+    project: Optional[str] = None,
+    worker: str = "all",
+    env: Optional[Dict[str, str]] = None,
+    workdir: str = "~/ddl",
+    python: str = "python3",
+) -> List[str]:
+    """Build the ``gcloud … ssh --worker=all`` argv for a pod-wide run.
+
+    The remote command mirrors the reference's job ``commandLine``
+    (``01_Train*.ipynb`` cell 15): env exports (mpirun ``-x``), then
+    ``python -u <script>``. ``DISTRIBUTED=True`` switches
+    ``maybe_initialize`` onto the TPU-metadata autodetect path.
+    """
+    exports = {"DISTRIBUTED": "True", **(env or {})}
+    export_str = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(exports.items())
+    )
+    remote = (
+        f"cd {workdir} && {export_str} {python} -u "
+        f"{shlex.quote(script)} {' '.join(shlex.quote(a) for a in script_args)}"
+    ).strip()
+    cmd = [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "ssh",
+        tpu,
+        f"--zone={zone}",
+        f"--worker={worker}",
+        f"--command={remote}",
+    ]
+    if project:
+        cmd.insert(5, f"--project={project}")
+    return cmd
+
+
+def launch_pod(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    tpu: str,
+    zone: str,
+    project: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    dry_run: bool = False,
+    sink=None,
+) -> int:
+    """Submit a pod-wide run (streams combined worker output via ssh)."""
+    sink = sink or sys.stdout
+    cmd = build_pod_command(
+        script, script_args, tpu=tpu, zone=zone, project=project, env=env
+    )
+    sink.write("launch: " + " ".join(shlex.quote(c) for c in cmd) + "\n")
+    if dry_run:
+        return 0
+    return subprocess.call(cmd)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="launch.py",
+        description="Launch a training script across processes (local) or "
+        "TPU-VM workers (pod).",
+    )
+    ap.add_argument("--num-processes", "-n", type=int, default=None)
+    ap.add_argument(
+        "--devices-per-process",
+        type=int,
+        default=None,
+        help="force this many host devices per process (CPU smoke mode)",
+    )
+    ap.add_argument(
+        "--platform",
+        choices=("cpu", "tpu"),
+        default=None,
+        help="override the JAX platform in children (cpu = smoke test)",
+    )
+    ap.add_argument(
+        "--env",
+        "-x",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="set env var in every process (mpirun -x equivalent)",
+    )
+    ap.add_argument("--tpu", default=None, help="TPU pod name (pod mode)")
+    ap.add_argument("--zone", default=None)
+    ap.add_argument("--project", default=None)
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("--no-tag-output", action="store_true")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    extra_env = _parse_env_args(args.env)
+    if args.tpu:
+        if not args.zone:
+            ap.error("--tpu requires --zone")
+        for flag, val in (
+            ("--num-processes", args.num_processes),
+            ("--devices-per-process", args.devices_per_process),
+            ("--platform", args.platform),
+            ("--timeout", args.timeout),
+        ):
+            if val is not None:
+                ap.error(f"{flag} applies to local mode only, not --tpu")
+        return launch_pod(
+            args.script,
+            args.script_args,
+            tpu=args.tpu,
+            zone=args.zone,
+            project=args.project,
+            env=extra_env,
+            dry_run=args.dry_run,
+        )
+    n = args.num_processes or 2
+    if args.dry_run:
+        print(
+            f"launch: would fork {n} local processes of "
+            f"{args.script} {' '.join(args.script_args)}"
+        )
+        return 0
+    return launch_local(
+        args.script,
+        args.script_args,
+        num_processes=n,
+        devices_per_process=args.devices_per_process,
+        platform=args.platform,
+        env=extra_env,
+        tag_output=not args.no_tag_output,
+        timeout=args.timeout,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

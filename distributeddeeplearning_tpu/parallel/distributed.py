@@ -50,6 +50,13 @@ def maybe_initialize(
     if process_id is None and "DDL_PROCESS_ID" in os.environ:
         process_id = int(os.environ["DDL_PROCESS_ID"])
 
+    # The launcher's smoke mode (launch.py --platform cpu) must win over a
+    # TPU plugin that force-set jax_platforms at import time; env var alone
+    # is overridden, so re-apply via config before the backend initialises.
+    platform = os.environ.get("DDL_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
     explicit = coordinator_address is not None
     autodetect = (
         os.environ.get("DISTRIBUTED", "").strip().lower()

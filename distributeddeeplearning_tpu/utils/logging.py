@@ -27,14 +27,32 @@ def _get_rank() -> int:
     """Process index, tolerating an uninitialized backend.
 
     Mirrors the reference's ``_get_rank`` which swallows pre-init Horovod
-    errors (``imagenet_estimator_tf_horovod.py:60-67``).
+    errors (``imagenet_estimator_tf_horovod.py:60-67``). Crucially this
+    must NOT initialise the backend itself: ``jax.process_index()`` before
+    ``jax.distributed.initialize`` would permanently lock the process into
+    a single-host world. Pre-init, fall back to the launcher's
+    ``DDL_PROCESS_ID``.
     """
     try:
         import jax
+        from jax._src import xla_bridge
 
-        return jax.process_index()
+        if xla_bridge.backends_are_initialized():
+            return jax.process_index()
+    except AttributeError:
+        # Private probe moved in a jax upgrade: fall back to our own init
+        # flag so post-initialize ranks are still correct.
+        from distributeddeeplearning_tpu.parallel import distributed
+
+        if distributed._initialized:
+            import jax
+
+            return jax.process_index()
     except Exception:
-        return 0
+        pass
+    import os
+
+    return int(os.environ.get("DDL_PROCESS_ID", 0))
 
 
 class RankAdapter(logging.LoggerAdapter):
@@ -45,13 +63,17 @@ class RankAdapter(logging.LoggerAdapter):
     """
 
     def __init__(self, logger: logging.Logger, rank: Optional[int] = None):
-        super().__init__(logger, {"rank": _get_rank() if rank is None else rank})
+        # rank=None → resolve at log time: on the pod-autodetect path the
+        # adapter is constructed before jax.distributed.initialize, when
+        # the true process index isn't knowable yet.
+        super().__init__(logger, {"rank": rank})
 
     def process(self, msg, kwargs: MutableMapping[str, Any]):
         extra = kwargs.pop("extra", {})
         epoch = extra.get("epoch")
         prefix = f"[Epoch {epoch}] " if epoch is not None else ""
-        kwargs["extra"] = {"rank": self.extra["rank"]}
+        rank = self.extra["rank"]
+        kwargs["extra"] = {"rank": _get_rank() if rank is None else rank}
         return f"{prefix}{msg}", kwargs
 
 

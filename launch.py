@@ -1,0 +1,12 @@
+#!/usr/bin/env python3
+"""Repo-root launcher shim — ``python launch.py …``.
+
+See :mod:`distributeddeeplearning_tpu.launch` (the mpirun / Batch-AI job
+submission equivalent; reference ``Horovod*/00_CreateImageAndTest.ipynb``
+cells 6-7 and ``01_Train*.ipynb`` cells 15-26).
+"""
+
+from distributeddeeplearning_tpu.launch import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

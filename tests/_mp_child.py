@@ -1,0 +1,112 @@
+"""Child process for the true multi-process tests (run via launch.py).
+
+This is the code every rank of a 2-process world executes — the analogue
+of the script the reference runs under ``mpirun -np 2 -H localhost:2``
+(``Horovod*/00_CreateImageAndTest.ipynb`` cells 6-7). It exercises every
+multi-host branch the single-process suite cannot reach:
+
+* ``maybe_initialize`` explicit rendezvous (DDL_* contract),
+* ``broadcast_from_master`` / ``allreduce_host_scalar``,
+* ``shard_batch``'s ``make_array_from_process_local_data`` branch,
+* a real data-parallel train step over a cross-process mesh,
+* per-process TFRecord file sharding (disjoint + complete coverage).
+
+Prints ``MP_CHILD_OK <rank>`` on success; any assertion kills the world
+via the launcher's all-or-nothing exit semantics.
+"""
+
+import sys
+
+import numpy as np
+
+from distributeddeeplearning_tpu.parallel import collectives, distributed
+
+
+def main() -> None:
+    tfrecord_pattern = sys.argv[1] if len(sys.argv) > 1 else None
+
+    assert distributed.maybe_initialize(), "DDL_* env contract not picked up"
+
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    rank = jax.process_index()
+
+    # --- host-level collectives (reference broadcast/allreduce uses) ------
+    tree = {"w": np.full((3,), float(rank), np.float32), "epoch": np.int32(rank + 5)}
+    got = collectives.broadcast_from_master(tree)
+    assert float(np.asarray(got["w"])[0]) == 0.0, got
+    assert int(got["epoch"]) == 5, got
+
+    avg = collectives.allreduce_host_scalar(float(rank + 1))  # (1+2)/2
+    assert abs(avg - 1.5) < 1e-6, avg
+    tot = collectives.allreduce_host_scalar(float(rank + 1), average=False)
+    assert abs(tot - 3.0) < 1e-6, tot
+
+    # --- global batch assembly + DP train step over both processes -------
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models.resnet import ResNet
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+    from distributeddeeplearning_tpu.training import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+    from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+    cfg = TrainConfig(
+        batch_size_per_device=2, image_size=32, num_classes=8, fake_data_length=64
+    )
+    mesh = data_parallel_mesh()
+    model = ResNet(depth=18, num_classes=8, dtype=jnp.bfloat16)
+    tx, _ = create_optimizer(cfg, steps_per_epoch=4)
+    state = replicate_state(create_train_state(model, cfg, tx), mesh)
+    step = make_train_step(model, tx, mesh, cfg)
+
+    rng = np.random.RandomState(7 + rank)  # distinct local shards
+    local = (
+        rng.uniform(-1, 1, size=(8, 32, 32, 3)).astype(np.float32),
+        rng.randint(0, 8, size=(8,)).astype(np.int32),
+    )
+    batch = shard_batch(local, mesh)
+    assert batch[0].shape[0] == 16, batch[0].shape  # global, not local
+    assert not batch[0].is_fully_addressable  # true cross-process array
+
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), loss
+
+    # --- per-process TFRecord sharding (disjoint + complete) -------------
+    if tfrecord_pattern:
+        from jax.experimental import multihost_utils
+
+        from distributeddeeplearning_tpu.data.imagenet import TFRecordImageNetDataset
+
+        ds = TFRecordImageNetDataset(
+            tfrecord_pattern,
+            global_batch_size=8,
+            image_size=8,
+            train=False,
+            process_index=rank,
+            process_count=2,
+            length=32,
+        )
+        labels = []
+        for _, y in ds.epoch(0):
+            labels.extend(int(v) for v in y)
+        assert len(labels) == 16, len(labels)
+        mine = np.sort(np.asarray(labels, np.int32))
+        both = multihost_utils.process_allgather(mine)
+        union = np.sort(both.reshape(-1))
+        assert union.tolist() == list(range(32)), union  # disjoint + complete
+
+    print(f"MP_CHILD_OK {rank} loss={loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()
