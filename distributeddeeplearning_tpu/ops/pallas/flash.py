@@ -7,10 +7,11 @@ matrix in HBM; this kernel streams K/V blocks through VMEM with the
 online-softmax recurrence, so peak memory is ``O(T·d)`` and the scores
 never leave the chip:
 
-  forward : one grid program per (batch, head, q-block). Running
+  forward : grid ``(batch·head, q-block, k-block)`` with K innermost —
+            one (q, k, v) tile resident in VMEM per program. Running
             row-max ``m``, normaliser ``l`` and the f32 accumulator are
-            carried through a ``fori_loop`` over K blocks; the MXU sees
-            two matmuls per block (``q·kᵀ`` and ``p·v``).
+            carried in VMEM scratch across the sequential K dimension;
+            the MXU sees two matmuls per block (``q·kᵀ`` and ``p·v``).
   backward: custom VJP using the saved per-row logsumexp, recomputed
             blockwise in pure JAX (a ``lax.scan`` over K blocks) — the
             standard flash-attention backward recurrence, also without
@@ -42,28 +43,71 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _pick_block(pref: int, t: int) -> int:
+    """Largest block ≤ ``pref`` that minimises trailing-block padding.
+
+    A fixed big block wastes up to a whole block of MXU work on awkward
+    lengths (T=513 @ 512 → 2x padding); halve down to 128 (below which
+    MXU tiles go idle) picking the smallest padded total.
+    """
+    if t <= 128:
+        return min(pref, _ceil_to(t, 8))
+    cands = []
+    c = max(pref, 128)
+    while c >= 128:
+        cands.append(c)
+        c //= 2
+    return min(cands, key=lambda c: (_ceil_to(t, c), -c))
+
+
+_LANES = 128  # VPU lane width: m/l scratch rows are lane-replicated
+
+
 def _flash_fwd_kernel(
     q_ref,
     k_ref,
     v_ref,
     o_ref,
     lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
     *,
     scale: float,
     causal: bool,
-    block_k: int,
     kv_len: int,
 ):
-    """One (batch·head, q-block) program: stream K/V blocks, online softmax."""
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
-    block_q, d = q.shape
-    num_kb = k_ref.shape[1] // block_k
+    """One (batch·head, q-block, k-block) program with K innermost.
+
+    Only one (block_q, d) + 2·(block_k, d) tile is resident in VMEM per
+    program — K/V genuinely stream, so sequence length is bounded by HBM,
+    not VMEM. The online-softmax state (running max ``m``, normaliser
+    ``l``, f32 accumulator) lives in VMEM scratch, which TPU Pallas
+    persists across the sequentially-executed minor grid dimension.
+    """
+    j = pl.program_id(2)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
     q_start = pl.program_id(1) * block_q
 
-    def body(j, carry):
-        m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: K blocks strictly past this q-block's last row contribute
+    # nothing — skip their matmuls entirely (~2x less MXU work at long T).
+    live = (j * block_k <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        # Matmuls stay in the input dtype (bf16 → full-rate MXU) with f32
+        # accumulation via preferred_element_type; only the softmax state
+        # is f32.
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q,
             k,
@@ -83,66 +127,76 @@ def _flash_fwd_kernel(
             mask = jnp.logical_and(mask, q_idx >= k_idx)
         s = jnp.where(mask, s, _NEG_INF)
 
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        m_prev = m_scr[:]  # [block_q, _LANES], lane-replicated
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)  # lane-replicated
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    if causal:
-        # Skip K blocks entirely in this q-block's masked future (~2x
-        # less MXU work for long causal T). Upper bound: blocks through
-        # the diagonal of the last q row in this block.
-        num_kb = jnp.minimum(
-            num_kb, lax.div(q_start + block_q + block_k - 1, block_k)
-        )
-    m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padded) q rows
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padded) q rows
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # Lane-replicated [block_q, _LANES]: Mosaic requires the last two
+        # block dims to tile (8, 128); a (1, block_q) row block does not.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:]))
 
 
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     """Core: BHTD tensors, padded lengths handled here."""
     bh, tq, d = q.shape
     tk = k.shape[1]
-    bq = min(block_q, _ceil_to(tq, 8))
-    bk = min(block_k, _ceil_to(tk, 8))
+    bq = _pick_block(block_q, tq)
+    bk = _pick_block(block_k, tk)
     tq_p = _ceil_to(tq, bq)
     tk_p = _ceil_to(tk, bk)
     qp = jnp.pad(q, ((0, 0), (0, tq_p - tq), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0)))
+    num_kb = tk_p // bk
 
     kernel = functools.partial(
-        _flash_fwd_kernel, scale=scale, causal=causal, block_k=bk, kv_len=tk
+        _flash_fwd_kernel, scale=scale, causal=causal, kv_len=tk
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, tq_p // bq),
+        grid=(bh, tq_p // bq, num_kb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk_p, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk_p, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p, _LANES), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        # K (minor) carries the online-softmax recurrence and must stay
+        # sequential; batch·head and q-blocks are free to parallelise.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :tq], lse[:, :tq]
+    return out[:, :tq], lse[:, :tq, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -216,8 +270,8 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Flash attention over BTHD ``[batch, seq, heads, head_dim]`` tensors.
