@@ -19,6 +19,7 @@ def make_dataset(config, train: bool = True):
             seed=config.seed if train else config.seed + 10_000,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
+            exact=not train,
         )
     from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
 

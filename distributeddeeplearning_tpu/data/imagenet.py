@@ -155,7 +155,13 @@ class ImageFolderDataset:
         self.num_workers = max(num_workers, 1)
         self.process_index = process_index
         self.process_count = process_count
-        self.steps_per_epoch = max(len(self.samples) // global_batch_size, 1)
+        if train:
+            self.steps_per_epoch = max(len(self.samples) // global_batch_size, 1)
+        else:
+            # Exact full-set eval: ceil + pad-and-mask the trailing batch,
+            # so top-1/top-5 cover every image exactly once (the reference
+            # wrapped indices modulo and double-counted).
+            self.steps_per_epoch = -(-len(self.samples) // global_batch_size)
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -182,13 +188,27 @@ class ImageFolderDataset:
 
         with concurrent.futures.ThreadPoolExecutor(self.num_workers) as pool:
             for step in range(self.steps_per_epoch):
-                idxs = [
-                    (j, int(local[(step * b + j) % len(local)])) for j in range(b)
-                ]
-                results = list(pool.map(decode, idxs))
-                images = np.stack([r[0] for r in results])
-                labels = np.asarray([r[1] for r in results], np.int32)
-                yield images, labels
+                if self.train:
+                    idxs = [
+                        (j, int(local[(step * b + j) % len(local)])) for j in range(b)
+                    ]
+                    results = list(pool.map(decode, idxs))
+                    images = np.stack([r[0] for r in results])
+                    labels = np.asarray([r[1] for r in results], np.int32)
+                    yield images, labels
+                else:
+                    # Eval: slots past this process's share are zero-weight
+                    # padding (decode sample 0 as a dummy).
+                    slots = np.arange(step * b, step * b + b)
+                    weights = (slots < len(local)).astype(np.float32)
+                    idxs = [
+                        (j, int(local[s]) if s < len(local) else 0)
+                        for j, s in enumerate(slots)
+                    ]
+                    results = list(pool.map(decode, idxs))
+                    images = np.stack([r[0] for r in results])
+                    labels = np.asarray([r[1] for r in results], np.int32)
+                    yield images, labels, weights
 
     def __iter__(self):
         return self.epoch(0)
@@ -243,7 +263,11 @@ class TFRecordImageNetDataset:
             # precisely so real runs never hit this.
             length = sum(1 for f in files for _ in tf.data.TFRecordDataset(f))
         self.length = length
-        self.steps_per_epoch = max(length // global_batch_size, 1)
+        if train:
+            self.steps_per_epoch = max(length // global_batch_size, 1)
+        else:
+            # Exact full-set eval (see ImageFolderDataset): ceil + pad+mask.
+            self.steps_per_epoch = -(-length // global_batch_size)
 
     def _parse(self, record, training: bool):
         tf = self._tf
@@ -288,32 +312,64 @@ class TFRecordImageNetDataset:
 
     def epoch(self, epoch_index: int = 0):
         tf = self._tf
-        ds = tf.data.Dataset.from_tensor_slices(self.files)
-        ds = ds.shard(self.process_count, self.process_index)
         if self.train:
+            ds = tf.data.Dataset.from_tensor_slices(self.files)
+            ds = ds.shard(self.process_count, self.process_index)
             ds = ds.shuffle(len(self.files), seed=self.seed + epoch_index)
-        ds = ds.interleave(
-            tf.data.TFRecordDataset,
-            cycle_length=tf.data.AUTOTUNE,
-            num_parallel_calls=tf.data.AUTOTUNE,
-        )
-        # Every process MUST yield exactly steps_per_epoch batches: a host
-        # whose file shard is smaller would otherwise stop early while
-        # others enter another compiled step, and the in-step collective
-        # would hang the pod. repeat() wraps short shards; take() truncates
-        # long ones.
-        ds = ds.repeat()
-        if self.train:
+            ds = ds.interleave(
+                tf.data.TFRecordDataset,
+                cycle_length=tf.data.AUTOTUNE,
+                num_parallel_calls=tf.data.AUTOTUNE,
+            )
+            # Every process MUST yield exactly steps_per_epoch batches: a
+            # host whose file shard is smaller would otherwise stop early
+            # while others enter another compiled step, and the in-step
+            # collective would hang the pod. repeat() wraps short shards;
+            # take() truncates long ones.
+            ds = ds.repeat()
             ds = ds.shuffle(self.shuffle_buffer, seed=self.seed + epoch_index)
+            ds = ds.map(
+                lambda r: self._parse(r, True),
+                num_parallel_calls=tf.data.AUTOTUNE,
+            )
+            ds = ds.batch(self.local_batch_size, drop_remainder=True)
+            ds = ds.take(self.steps_per_epoch)
+            ds = ds.prefetch(tf.data.AUTOTUNE)
+            for images, labels in ds.as_numpy_iterator():
+                yield images, labels
+            return
+
+        # Eval: exact coverage. Shard by *record* (round-robin over the
+        # sequential concatenation of shards — every record lands on
+        # exactly one process regardless of uneven file sizes), then pad
+        # each process's stream to the common padded length with
+        # zero-weight dummies so all hosts step in lockstep.
+        p, n = self.process_index, self.process_count
+        size = self.image_size
+        ds = tf.data.TFRecordDataset(self.files)
+        ds = ds.shard(n, p)
         ds = ds.map(
-            lambda r: self._parse(r, self.train),
+            lambda r: self._parse(r, False),
             num_parallel_calls=tf.data.AUTOTUNE,
         )
+        ds = ds.map(lambda im, lb: (im, lb, tf.ones((), tf.float32)))
+        # Unbounded pad + take() below: every process yields exactly
+        # steps_per_epoch batches even if self.length (count.txt / user
+        # arg) disagrees with the shards — a short process would
+        # otherwise hang the pod in the eval psum.
+        pad = tf.data.Dataset.from_tensors(
+            (
+                tf.zeros((size, size, 3), tf.float32),
+                tf.zeros((), tf.int32),
+                tf.zeros((), tf.float32),
+            )
+        ).repeat()
+        ds = ds.concatenate(pad)
         ds = ds.batch(self.local_batch_size, drop_remainder=True)
         ds = ds.take(self.steps_per_epoch)
         ds = ds.prefetch(tf.data.AUTOTUNE)
-        for images, labels in ds.as_numpy_iterator():
-            yield images, labels
+        for images, labels, weights in ds.as_numpy_iterator():
+            yield images, labels, weights
 
     def __len__(self) -> int:
         return self.length

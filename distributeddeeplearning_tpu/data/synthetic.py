@@ -46,6 +46,7 @@ class SyntheticImageDataset:
         process_index: int = 0,
         process_count: int = 1,
         one_hot: bool = False,
+        exact: bool = False,
         dtype: np.dtype = np.float32,
     ):
         if global_batch_size % process_count != 0:
@@ -71,11 +72,20 @@ class SyntheticImageDataset:
         # Virtual→physical translation index (reference data_generator.py:45).
         # Sized to the *local* share of the virtual length; offset by process
         # index so hosts draw disjoint streams (DistributedSampler parity).
-        local_len = length // process_count
+        # exact=True (validation): ceil instead of floor/truncate — every
+        # virtual sample is served exactly once, with the trailing partial
+        # batch padded and zero-weighted.
+        self.exact = exact
+        if exact:
+            local_len = (length - process_index + process_count - 1) // process_count
+            self.steps_per_epoch = -(-length // global_batch_size)
+        else:
+            local_len = length // process_count
+            self.steps_per_epoch = max(length // global_batch_size, 1)
         self._idx_seed = (seed + 1 + process_index) % (2**31 - 1)
         idx_rng = np.random.RandomState(self._idx_seed)
-        self._translation_index = idx_rng.randint(0, pool_n, size=(local_len,))
-        self.steps_per_epoch = max(length // global_batch_size, 1)
+        self._translation_index = idx_rng.randint(0, pool_n, size=(max(local_len, 1),))
+        self._local_len = local_len
 
     def __len__(self) -> int:
         return self.length
@@ -93,12 +103,18 @@ class SyntheticImageDataset:
         index = perm_rng.permutation(self._translation_index)
         for step in range(self.steps_per_epoch):
             start = step * b
-            sel = index[np.arange(start, start + b) % len(index)]
+            slots = np.arange(start, start + b)
+            sel = index[slots % len(index)]
             images = self._images[sel]
             labels = self._labels[sel]
             if self.one_hot:
                 labels = np.eye(self.num_classes, dtype=np.float32)[labels]
-            yield images, labels
+            if self.exact:
+                # weight 0 on padded slots past this process's share
+                weights = (slots < self._local_len).astype(np.float32)
+                yield images, labels, weights
+            else:
+                yield images, labels
 
     def __iter__(self):
         return self.epoch(0)

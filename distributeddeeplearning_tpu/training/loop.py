@@ -69,6 +69,7 @@ def fit(
     checkpoint_manager: Optional[CheckpointManager] = None,
     add_default_logger: bool = True,
     state: Optional[TrainState] = None,
+    initial_epoch: int = 0,
 ) -> FitResult:
     """Train ``model`` for ``epochs`` over ``train_data`` on ``mesh``.
 
@@ -123,9 +124,13 @@ def fit(
         )
     engine_saves = ckpt is not None and ckpt_cb is None
 
-    start_epoch = 0
+    # Keras resume contract (reference :323-341): load_weights +
+    # initial_epoch skips completed epochs and keeps the LR schedule
+    # position. Checkpoint-derived epoch wins if it is further along.
+    start_epoch = initial_epoch
     if ckpt is not None and ckpt.enabled and config.resume:
-        state, start_epoch = ckpt.maybe_restore(state)
+        state, ckpt_epoch = ckpt.maybe_restore(state)
+        start_epoch = max(start_epoch, ckpt_epoch)
         if start_epoch:
             log.info("resuming from epoch %d", start_epoch)
 
@@ -188,16 +193,24 @@ def fit(
 
 
 def _run_eval(eval_step, state, eval_data, mesh, config) -> Dict[str, float]:
+    """Sample-exact evaluation: each batch's means are re-weighted by its
+    real-sample ``count``, so padded tail batches (exact-coverage datasets)
+    and full batches combine into metrics over exactly the dataset."""
     totals: Dict[str, float] = {}
-    n = 0
+    samples = 0.0
     for batch in prefetch_to_device(
         eval_data.epoch(0), mesh, size=config.prefetch_batches
     ):
-        m = eval_step(state, batch)
+        m = {k: float(jax.device_get(v)) for k, v in eval_step(state, batch).items()}
+        count = m.pop("count", None)
+        if count is None:  # legacy eval step: unweighted batch means
+            count = 1.0
+        samples += count
         for k, v in m.items():
-            totals[k] = totals.get(k, 0.0) + float(jax.device_get(v))
-        n += 1
-    return {k: v / max(n, 1) for k, v in totals.items()}
+            totals[k] = totals.get(k, 0.0) + v * count
+    out = {k: v / max(samples, 1.0) for k, v in totals.items()}
+    out["samples"] = samples
+    return out
 
 
 def evaluate(

@@ -206,24 +206,38 @@ def make_pjit_train_step(
 def make_pjit_eval_step(
     model, mesh: Mesh
 ) -> Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]:
+    """Same eval contract as the DP engine (``train_step.make_eval_step``):
+    accepts ``(images, labels[, weights])``, returns weighted batch means
+    plus the real-sample ``count`` — with GSPMD the weighted sums are
+    plain global reductions, no explicit psum needed."""
+    from distributeddeeplearning_tpu.training.train_step import eval_metrics_fn
+
     batch_sharding = _mesh_batch_sharding(mesh)
 
-    def eval_step(state: TrainState, batch: Batch):
-        images, labels = batch
+    def eval_step(state: TrainState, batch):
+        images, labels, weights = batch
         images = lax.with_sharding_constraint(images, batch_sharding)
         labels = lax.with_sharding_constraint(labels, batch_sharding)
+        weights = lax.with_sharding_constraint(weights, batch_sharding)
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images,
             train=False,
         )
-        loss = cross_entropy_loss(logits, labels)
-        top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
-        top5 = jnp.mean(
-            jnp.any(
-                jnp.argsort(logits, axis=-1)[:, -5:] == labels[:, None], axis=-1
-            ).astype(jnp.float32)
-        )
-        return {"loss": loss, "top1": top1, "top5": top5}
+        sums = eval_metrics_fn(logits, labels, weights)
+        count = sums.pop("count")
+        safe = jnp.maximum(count, 1.0)
+        out = {k: v / safe for k, v in sums.items()}
+        out["count"] = count
+        return out
 
-    return jax.jit(eval_step)
+    jitted = jax.jit(eval_step)
+
+    def step(state: TrainState, batch):
+        if len(batch) == 2:
+            images, labels = batch
+            weights = jnp.ones(labels.shape[:1], jnp.float32)
+            batch = (images, labels, weights)
+        return jitted(state, batch)
+
+    return step

@@ -196,41 +196,88 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
 
 
+def eval_metrics_fn(
+    logits: jnp.ndarray, labels: jnp.ndarray, weights: jnp.ndarray
+) -> Dict[str, jnp.ndarray]:
+    """Per-shard weighted metric sums (shared by the DP and pjit engines).
+
+    ``weights`` ∈ {0, 1} marks real vs padded samples, so a final partial
+    validation batch can be padded to the static shape and masked out —
+    every sample counts exactly once, unlike the reference's
+    floor+modulo-wrap eval (and its ``validate()`` which simply drops the
+    tail).
+    """
+    w = weights.astype(jnp.float32)
+    per_ex = -jnp.take_along_axis(
+        jax.nn.log_softmax(logits), labels[:, None], axis=-1
+    )[:, 0]
+    top1 = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+    top5 = jnp.any(
+        jnp.argsort(logits, axis=-1)[:, -5:] == labels[:, None], axis=-1
+    ).astype(jnp.float32)
+    return {
+        "loss": jnp.sum(per_ex * w),
+        "top1": jnp.sum(top1 * w),
+        "top5": jnp.sum(top5 * w),
+        "count": jnp.sum(w),
+    }
+
+
 def make_eval_step(
     model, mesh: Mesh
 ) -> Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]:
-    """Compiled eval step: running-stats BN, cross-replica-averaged metrics
-    (reference eval: TF ``:203-213``, Keras ``hvd.allreduce(score)``
-    ``:344-353``)."""
+    """Compiled eval step: running-stats BN, cross-replica-summed weighted
+    metrics (reference eval: TF ``:203-213``, Keras ``hvd.allreduce(score)``
+    ``:344-353``).
+
+    Accepts ``(images, labels)`` or ``(images, labels, weights)``; returns
+    per-batch means ``{loss, top1, top5}`` plus ``count``, the number of
+    *real* (weight-1) samples — exact-coverage eval divides accumulated
+    ``metric·count`` sums by accumulated counts (``loop._run_eval``).
+    """
     axes = batch_axes(mesh)
     if not axes:
         raise ValueError(f"mesh {mesh.axis_names} has no batch axis")
     axis = axes if len(axes) > 1 else axes[0]
 
-    def local_eval(state: TrainState, batch: Batch):
-        images, labels = batch
+    def local_eval(state: TrainState, batch):
+        images, labels, weights = batch
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images,
             train=False,
         )
-        loss = cross_entropy_loss(logits, labels)
-        top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
-        top5 = jnp.mean(
-            jnp.any(
-                jnp.argsort(logits, axis=-1)[:, -5:] == labels[:, None], axis=-1
-            ).astype(jnp.float32)
-        )
-        return lax.pmean({"loss": loss, "top1": top1, "top5": top5}, axis)
+        sums = lax.psum(eval_metrics_fn(logits, labels, weights), axis)
+        count = sums.pop("count")
+        safe = jnp.maximum(count, 1.0)  # all-padding batch
+        out = {k: v / safe for k, v in sums.items()}
+        out["count"] = count
+        return out
 
     batch_spec = P(axis if isinstance(axis, str) else tuple(axes))
-    sharded = jax.shard_map(
-        local_eval,
-        mesh=mesh,
-        in_specs=(P(), (batch_spec, batch_spec)),
-        out_specs=P(),
+    sharded = jax.jit(
+        jax.shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=(P(), (batch_spec, batch_spec, batch_spec)),
+            out_specs=P(),
+        )
     )
-    return jax.jit(sharded)
+
+    def step(state: TrainState, batch):
+        if len(batch) == 2:
+            # Convenience (single-host tests): all samples real.
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "multi-host eval requires (images, labels, weights) "
+                    "batches — use an exact-eval dataset (train=False)"
+                )
+            images, labels = batch
+            weights = jnp.ones(labels.shape[:1], jnp.float32)
+            batch = (images, labels, weights)
+        return sharded(state, batch)
+
+    return step
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:

@@ -97,7 +97,7 @@ def test_tfrecords_roundtrip(image_tree, tmp_path):
     imgs, labels = batches[0]
     assert imgs.shape == (8, 16, 16, 3)
     assert labels.dtype == np.int32
-    # eval path too
+    # eval path too: 3-tuples with weights, exact coverage
     ds_eval = TFRecordImageNetDataset(
         str(tmp_path / "tfr" / "imagenet-*"),
         global_batch_size=8,
@@ -105,8 +105,75 @@ def test_tfrecords_roundtrip(image_tree, tmp_path):
         train=False,
         length=24,
     )
-    imgs, _ = next(iter(ds_eval.epoch(0)))
+    imgs, _, w = next(iter(ds_eval.epoch(0)))
     assert imgs.shape == (8, 16, 16, 3)
+    assert w.shape == (8,)
+
+
+def test_tfrecord_eval_exact_coverage_nondivisible(image_tree, tmp_path):
+    """24 records, global batch 7 → ceil = 4 steps; every record exactly
+    once across 2 simulated processes, padding zero-weighted."""
+    write_tfrecords(image_tree, str(tmp_path / "tfr"), num_shards=3)
+    seen = []
+    total_w = 0.0
+    for p in (0, 1):
+        ds = TFRecordImageNetDataset(
+            str(tmp_path / "tfr" / "imagenet-*"),
+            global_batch_size=14,
+            image_size=16,
+            train=False,
+            length=24,
+            process_index=p,
+            process_count=2,
+        )
+        assert ds.steps_per_epoch == 2  # ceil(24/14)
+        nb = 0
+        for imgs, labels, w in ds.epoch(0):
+            assert imgs.shape[0] == 7 and w.shape == (7,)
+            seen.extend(labels[w > 0].tolist())
+            total_w += float(w.sum())
+            nb += 1
+        assert nb == 2  # both processes step in lockstep
+    assert total_w == 24.0  # every record weighted exactly once
+    assert len(seen) == 24
+
+
+def test_tfrecord_eval_lockstep_with_stale_count(image_tree, tmp_path):
+    """A wrong record count (stale count.txt) must not break lockstep:
+    every process still yields exactly steps_per_epoch batches."""
+    write_tfrecords(image_tree, str(tmp_path / "tfr"), num_shards=2)
+    for p in (0, 1):
+        ds = TFRecordImageNetDataset(
+            str(tmp_path / "tfr" / "imagenet-*"),
+            global_batch_size=8,
+            image_size=16,
+            train=False,
+            length=27,  # actual shards hold 24
+            process_index=p,
+            process_count=2,
+        )
+        assert ds.steps_per_epoch == 4  # ceil(27/8)
+        batches = list(ds.epoch(0))
+        assert len(batches) == 4  # lockstep despite the lie
+
+
+def test_imagefolder_eval_exact_coverage(image_tree):
+    """ImageFolder eval: ceil steps, pad+mask, each image exactly once."""
+    total = 0.0
+    for p in (0, 1):
+        ds = ImageFolderDataset(
+            image_tree,
+            global_batch_size=10,
+            image_size=16,
+            train=False,
+            process_index=p,
+            process_count=2,
+        )
+        assert ds.steps_per_epoch == 3  # ceil(24/10)
+        for _, _, w in ds.epoch(0):
+            assert w.shape == (5,)
+            total += float(w.sum())
+    assert total == 24.0
 
 
 def test_valprep(tmp_path):

@@ -137,6 +137,15 @@ def test_pjit_eval_step(tp_mesh):
         m = eval_step(state, shard_batch(_batch(), tp_mesh))
     for key in ("loss", "top1", "top5"):
         assert np.isfinite(float(m[key]))
+    assert float(m["count"]) == 16.0
+    # exact-eval contract: zero-weight (padded) samples are masked out
+    images, labels = _batch()
+    weights = np.array([1.0] * 12 + [0.0] * 4, np.float32)
+    with tp_mesh:
+        mw = eval_step(state, shard_batch((images, labels, weights), tp_mesh))
+    assert float(mw["count"]) == 12.0
+    for key in ("loss", "top1", "top5"):
+        assert np.isfinite(float(mw[key]))
 
 
 def test_unannotated_model_trains_under_pjit(mesh8):

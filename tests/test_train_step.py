@@ -132,6 +132,51 @@ def test_eval_step(setup, mesh8):
     for k in ("loss", "top1", "top5"):
         assert np.isfinite(float(metrics[k]))
     assert float(metrics["top5"]) >= float(metrics["top1"])
+    assert float(metrics["count"]) == 16.0
+
+
+def test_eval_step_masks_padded_samples(setup, mesh8):
+    """Zero-weight slots must not affect metrics: same real samples with
+    different garbage in the padded slots → identical metrics, count=10."""
+    model, _, state, _ = setup
+    eval_step = make_eval_step(model, mesh8)
+    images, labels = _batch()
+    weights = np.array([1.0] * 10 + [0.0] * 6, np.float32)
+
+    def with_garbage(seed):
+        rng = np.random.RandomState(seed)
+        im = images.copy()
+        lb = labels.copy()
+        im[10:] = rng.randn(6, 16, 16, 3) * 50
+        lb[10:] = rng.randint(0, 10, size=(6,))
+        return im, lb, weights
+
+    m1 = eval_step(state, shard_batch(with_garbage(1), mesh8))
+    m2 = eval_step(state, shard_batch(with_garbage(2), mesh8))
+    assert float(m1["count"]) == 10.0
+    for k in ("loss", "top1", "top5"):
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-6)
+
+
+def test_exact_evaluation_covers_every_sample_once(setup, mesh8):
+    """Engine-level: synthetic exact val set of 100 @ global batch 16 →
+    7 lockstep batches, exactly 100 weighted samples."""
+    from distributeddeeplearning_tpu.training import loop
+
+    model, _, state, _ = setup
+    ds = SyntheticImageDataset(
+        length=100,
+        global_batch_size=16,
+        image_size=16,
+        num_classes=10,
+        num_physical_batches=2,
+        exact=True,
+    )
+    assert ds.steps_per_epoch == 7  # ceil(100/16)
+    metrics = loop.evaluate(model, CFG, ds, state, mesh=mesh8)
+    assert metrics["samples"] == 100.0
+    for k in ("loss", "top1", "top5"):
+        assert np.isfinite(metrics[k])
 
 
 def test_synthetic_pipeline_through_train_step(setup, mesh8):
