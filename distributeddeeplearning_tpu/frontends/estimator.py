@@ -51,7 +51,9 @@ class Estimator:
         self.run_config = run_config or RunConfig(model_dir=self.config.model_dir)
         if isinstance(model_fn, str):
             name = model_fn
-            model_fn = lambda cfg: get_model(name, num_classes=cfg.num_classes)
+            model_fn = lambda cfg: get_model(
+                name, num_classes=cfg.num_classes, dtype=cfg.compute_dtype
+            )
         self.model = model_fn(self.config)
         self._state: Optional[TrainState] = None
         self._ckpt = None
@@ -81,7 +83,7 @@ class Estimator:
             epochs=epochs,
             callbacks=hooks,
             checkpoint_manager=self._ckpt,
-            state=self._state_host(),
+            state=self._state,
         )
         self._state = result.state
         self.last_result = result
@@ -99,9 +101,6 @@ class Estimator:
             self._state,
             mesh=self.run_config.mesh,
         )
-
-    def _state_host(self):
-        return self._state
 
     @property
     def state(self) -> Optional[TrainState]:

@@ -30,7 +30,11 @@ class Model:
     def __init__(self, module_or_name, config: Optional[TrainConfig] = None, mesh=None):
         self.config = config or TrainConfig()
         self.module = (
-            get_model(module_or_name, num_classes=self.config.num_classes)
+            get_model(
+                module_or_name,
+                num_classes=self.config.num_classes,
+                dtype=self.config.compute_dtype,
+            )
             if isinstance(module_or_name, str)
             else module_or_name
         )
@@ -98,6 +102,7 @@ class Model:
             callbacks=callbacks,
             eval_data=validation_data,
             state=self._state,
+            initial_epoch=initial_epoch,
         )
         self._state = result.state
         self.config = cfg

@@ -33,10 +33,17 @@ def register_model(name: str, factory: Callable[..., Any]) -> None:
 
 
 def get_model(name: str, *, num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
-    """Instantiate a model by name (e.g. ``"resnet50"``)."""
+    """Instantiate a model by name (e.g. ``"resnet50"``).
+
+    ``dtype`` may be a jnp dtype or a string (``TrainConfig.compute_dtype``,
+    e.g. ``"bfloat16"``/``"float32"`` — the compute dtype of the forward
+    pass; params stay float32 either way).
+    """
     key = name.lower()
     if key not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    if isinstance(dtype, str):
+        dtype = jnp.dtype(dtype)
     return _REGISTRY[key](num_classes=num_classes, dtype=dtype, **kw)
 
 

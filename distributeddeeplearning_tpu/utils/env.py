@@ -1,0 +1,100 @@
+"""Infra utilities — parity with the reference's ``common/utils.py``.
+
+The reference keeps cluster bootstrap state in a ``.env`` file managed by
+python-dotenv: ``dotenv_for()`` locates/creates it (``common/utils.py:
+12-17``), ``get_password()`` interactively captures a secret into it
+(``:20-25``), and ``write_json_to_file()`` dumps job JSON for submission
+(``:28-31``). Same capabilities here with no third-party dependency —
+a minimal ``.env`` parser/writer (the file format is KEY=VALUE lines) —
+since the TPU orchestration layer (``orchestration/``) keeps project /
+zone / pod-name state the same way.
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+_DEFAULT_ENV = ".env"
+
+
+def dotenv_for(path: Optional[str] = None) -> str:
+    """Locate (or create) the project ``.env`` and return its path
+    (reference ``dotenv_for``, ``common/utils.py:12-17``)."""
+    path = path or os.path.join(os.getcwd(), _DEFAULT_ENV)
+    if not os.path.exists(path):
+        with open(path, "a"):
+            pass
+    return path
+
+
+def load_env_file(path: str) -> Dict[str, str]:
+    """Parse KEY=VALUE lines (comments/blank lines skipped, quotes
+    stripped)."""
+    out: Dict[str, str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip().strip("'\"")
+    return out
+
+
+def set_key(path: str, key: str, value: str) -> None:
+    """Idempotently set ``key=value`` in the env file (python-dotenv
+    ``set_key`` equivalent, used throughout ``01_CreateResources.ipynb``
+    cell 3)."""
+    lines = []
+    found = False
+    if os.path.exists(path):
+        with open(path) as f:
+            lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if line.split("=", 1)[0].strip() == key:
+            lines[i] = f"{key}={value}"
+            found = True
+            break
+    if not found:
+        lines.append(f"{key}={value}")
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    with os.fdopen(fd, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+
+def export_env_file(path: str, environ: Optional[Dict[str, str]] = None) -> None:
+    """Load the env file into the process environment (``load_dotenv``)."""
+    env = os.environ if environ is None else environ
+    for k, v in load_env_file(path).items():
+        env.setdefault(k, v)
+
+
+def get_secret(
+    key: str = "PASSWORD",
+    dotenv_path: Optional[str] = None,
+    prompt: Optional[str] = None,
+) -> str:
+    """Fetch ``key`` from the env file, interactively capturing it on
+    first use (reference ``get_password``, ``common/utils.py:20-25``)."""
+    path = dotenv_for(dotenv_path)
+    values = load_env_file(path)
+    if not values.get(key):
+        value = getpass.getpass(prompt or f"{key}: ")
+        set_key(path, key, value)
+        return value
+    return values[key]
+
+
+def write_json_to_file(json_dict: dict, filename: str, mode: str = "w") -> None:
+    """Dump a dict as indented JSON (reference ``write_json_to_file``,
+    ``common/utils.py:28-31``; used for Batch-AI job JSON — here for
+    launcher/orchestration manifests)."""
+    with open(filename, mode) as f:
+        json.dump(json_dict, f, indent=4, sort_keys=True)

@@ -1,0 +1,60 @@
+"""Infra-utils parity tests (reference ``common/utils.py:12-31``)."""
+
+import json
+import os
+
+from distributeddeeplearning_tpu.utils.env import (
+    dotenv_for,
+    export_env_file,
+    get_secret,
+    load_env_file,
+    set_key,
+    write_json_to_file,
+)
+
+
+def test_dotenv_roundtrip(tmp_path):
+    path = str(tmp_path / ".env")
+    assert dotenv_for(path) == path and os.path.exists(path)
+    set_key(path, "PROJECT", "my-proj")
+    set_key(path, "ZONE", "us-west4-a")
+    set_key(path, "PROJECT", "other")  # overwrite in place
+    vals = load_env_file(path)
+    assert vals == {"PROJECT": "other", "ZONE": "us-west4-a"}
+
+
+def test_load_skips_comments_and_quotes(tmp_path):
+    path = tmp_path / ".env"
+    path.write_text("# comment\n\nA='quoted'\nB=\"dq\"\nnoequals\n")
+    assert load_env_file(str(path)) == {"A": "quoted", "B": "dq"}
+
+
+def test_export_env_file(tmp_path):
+    path = tmp_path / ".env"
+    path.write_text("DDL_TEST_KEY=val\n")
+    env = {}
+    export_env_file(str(path), env)
+    assert env["DDL_TEST_KEY"] == "val"
+    env2 = {"DDL_TEST_KEY": "keep"}
+    export_env_file(str(path), env2)  # existing wins (setdefault)
+    assert env2["DDL_TEST_KEY"] == "keep"
+
+
+def test_get_secret_prompts_once(tmp_path, monkeypatch):
+    path = str(tmp_path / ".env")
+    calls = []
+
+    def fake_getpass(prompt):
+        calls.append(prompt)
+        return "s3cret"
+
+    monkeypatch.setattr("getpass.getpass", fake_getpass)
+    assert get_secret("TOKEN", path) == "s3cret"
+    assert get_secret("TOKEN", path) == "s3cret"  # from file, no reprompt
+    assert len(calls) == 1
+
+
+def test_write_json_to_file(tmp_path):
+    out = tmp_path / "job.json"
+    write_json_to_file({"b": 1, "a": [1, 2]}, str(out))
+    assert json.loads(out.read_text()) == {"a": [1, 2], "b": 1}

@@ -134,3 +134,20 @@ def test_frontends_agree(mesh8):
 def test_runconfig_mesh_is_field():
     rc = RunConfig(model_dir="x", mesh="placeholder")
     assert rc.mesh == "placeholder"
+
+
+def test_keras_initial_epoch_skips_completed_epochs(mesh8):
+    """Reference resume contract (:323-341): initial_epoch=2 with
+    epochs=3 runs exactly one epoch of steps."""
+    m = Model(_model(), CFG.replace(validation=False))
+    m.compile()
+    result = m.fit(_data(CFG), epochs=3, initial_epoch=2)
+    assert int(m.state.step) == 4  # one epoch: 64/(2*8)
+    assert len(result.history) == 1
+
+
+def test_compute_dtype_reaches_model():
+    m32 = Model("resnet18", CFG.replace(compute_dtype="float32"))
+    assert m32.module.dtype == jnp.float32
+    m16 = Model("resnet18", CFG.replace(compute_dtype="bfloat16"))
+    assert m16.module.dtype == jnp.bfloat16
