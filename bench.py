@@ -26,8 +26,9 @@ WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
 
-def run_bench(per_device_batch: int):
+def run_bench(per_device_batch: int, devices=None, profile_dir=None):
     import jax.numpy as jnp
+    import ml_dtypes
     import optax
 
     from distributeddeeplearning_tpu.config import TrainConfig
@@ -41,18 +42,29 @@ def run_bench(per_device_batch: int):
     )
     from distributeddeeplearning_tpu.training.train_step import replicate_state
 
-    n_dev = jax.device_count()
+    import os
+
+    # Smoke knobs (CPU-mesh tests): full protocol = depth 50 @ 224.
+    depth = int(os.environ.get("BENCH_DEPTH", "50"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+
+    n_dev = devices if devices is not None else jax.device_count()
     global_batch = per_device_batch * n_dev
-    cfg = TrainConfig(batch_size_per_device=per_device_batch)
-    model = ResNet(depth=50, num_classes=1000, dtype=jnp.bfloat16)
-    mesh = data_parallel_mesh()
+    cfg = TrainConfig(
+        batch_size_per_device=per_device_batch, image_size=image_size
+    )
+    model = ResNet(depth=depth, num_classes=1000, dtype=jnp.bfloat16)
+    mesh = data_parallel_mesh(n_dev)
     tx, _ = create_optimizer(cfg, steps_per_epoch=cfg.steps_per_epoch())
     state = replicate_state(create_train_state(model, cfg, tx), mesh)
     step = make_train_step(model, tx, mesh, cfg)
 
     rng = np.random.RandomState(42)
     host_batch = (
-        rng.uniform(-1, 1, size=(global_batch, 224, 224, 3)).astype(np.float32),
+        # Staged bf16 (PROFILE.md): model compute dtype, half the transfer.
+        rng.uniform(-1, 1, size=(global_batch, image_size, image_size, 3)).astype(
+            ml_dtypes.bfloat16
+        ),
         rng.randint(0, 1000, size=(global_batch,)).astype(np.int32),
     )
     batch = shard_batch(host_batch, mesh)
@@ -64,22 +76,54 @@ def run_bench(per_device_batch: int):
     # Fence with a host readback of a value that depends on every step in
     # the chain — block_until_ready alone does not reliably wait through
     # the axon loopback relay (it reported 165x hardware peak).
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = step(state, batch)
-    assert np.isfinite(float(metrics["loss"]))
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    prof = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+    with prof:
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        dt = time.perf_counter() - t0
 
     images_per_sec = MEASURE_STEPS * global_batch / dt
     return images_per_sec, n_dev
 
 
 def main():
+    import os
+
     last_err = None
-    for per_device_batch in (256, 128, 64, 32):
+    profile_dir = os.environ.get("BENCH_PROFILE") or None
+    scaling = os.environ.get("BENCH_SCALING", "") == "1"
+    batches = (256, 128, 64, 32)
+    if "BENCH_BATCH" in os.environ:
+        batches = (int(os.environ["BENCH_BATCH"]),)
+    for per_device_batch in batches:
         try:
-            ips, n_dev = run_bench(per_device_batch)
+            ips, n_dev = run_bench(per_device_batch, profile_dir=profile_dir)
             per_chip = ips / n_dev
+            detail = {
+                "devices": n_dev,
+                "per_device_batch": per_device_batch,
+                "images_per_sec_per_device": round(per_chip, 1),
+                "platform": jax.devices()[0].platform,
+                "baseline_images_per_sec_per_device": REFERENCE_IMAGES_PER_SEC_PER_DEVICE,
+            }
+            if scaling and n_dev > 1:
+                # Scaling-efficiency path (BASELINE >90% target, 8→64):
+                # images/sec/chip at 1 device vs all attached devices. A
+                # failed rerun must not discard the valid N-device result.
+                try:
+                    ips1, _ = run_bench(per_device_batch, devices=1)
+                    detail["images_per_sec_1_device"] = round(ips1, 1)
+                    detail["scaling_efficiency"] = round(per_chip / ips1, 4)
+                except Exception as e:
+                    detail["scaling_error"] = repr(e)
             print(
                 json.dumps(
                     {
@@ -89,13 +133,7 @@ def main():
                         "vs_baseline": round(
                             per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3
                         ),
-                        "detail": {
-                            "devices": n_dev,
-                            "per_device_batch": per_device_batch,
-                            "images_per_sec_per_device": round(per_chip, 1),
-                            "platform": jax.devices()[0].platform,
-                            "baseline_images_per_sec_per_device": REFERENCE_IMAGES_PER_SEC_PER_DEVICE,
-                        },
+                        "detail": detail,
                     }
                 )
             )

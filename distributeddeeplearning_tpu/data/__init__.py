@@ -2,12 +2,29 @@ from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
 from distributeddeeplearning_tpu.data.pipeline import shard_batch, prefetch_to_device
 
 
+def staging_dtype(config):
+    """Numpy dtype images are staged in: bf16 when ``config.compute_dtype``
+    is bf16 — halves host→HBM bytes. Numerically identical for any model
+    built from the same config (its first op is that exact cast,
+    post-transfer); if you pair a custom float32 module with this
+    factory, set ``compute_dtype="float32"`` so inputs are not
+    pre-quantized. See PROFILE.md."""
+    import numpy as np
+
+    if config.compute_dtype == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float32)
+
+
 def make_dataset(config, train: bool = True):
     """Dataset factory honoring the reference's FAKE switch (SURVEY.md §4.1):
     synthetic when ``config.fake`` or no data dir, else the real ImageNet
     pipeline."""
     import jax
 
+    dtype = staging_dtype(config)
     if config.fake or not (config.data_dir if train else config.val_data_dir):
         return SyntheticImageDataset(
             length=config.fake_data_length
@@ -20,6 +37,7 @@ def make_dataset(config, train: bool = True):
             process_index=jax.process_index(),
             process_count=jax.process_count(),
             exact=not train,
+            dtype=dtype,
         )
     from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
 
@@ -32,6 +50,7 @@ def make_dataset(config, train: bool = True):
         num_workers=config.num_workers,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+        image_dtype=dtype,
     )
 
 

@@ -139,12 +139,14 @@ class ImageFolderDataset:
         num_workers: int = 4,
         process_index: int = 0,
         process_count: int = 1,
+        image_dtype=np.float32,
     ):
         if global_batch_size % process_count != 0:
             raise ValueError(
                 f"global batch {global_batch_size} not divisible by "
                 f"{process_count} processes"
             )
+        self.image_dtype = np.dtype(image_dtype)
         self.samples, self.classes = _list_samples(root)
         self.num_classes = len(self.classes)
         self.global_batch_size = global_batch_size
@@ -193,7 +195,9 @@ class ImageFolderDataset:
                         (j, int(local[(step * b + j) % len(local)])) for j in range(b)
                     ]
                     results = list(pool.map(decode, idxs))
-                    images = np.stack([r[0] for r in results])
+                    images = np.stack([r[0] for r in results]).astype(
+                        self.image_dtype, copy=False
+                    )
                     labels = np.asarray([r[1] for r in results], np.int32)
                     yield images, labels
                 else:
@@ -206,7 +210,9 @@ class ImageFolderDataset:
                         for j, s in enumerate(slots)
                     ]
                     results = list(pool.map(decode, idxs))
-                    images = np.stack([r[0] for r in results])
+                    images = np.stack([r[0] for r in results]).astype(
+                        self.image_dtype, copy=False
+                    )
                     labels = np.asarray([r[1] for r in results], np.int32)
                     yield images, labels, weights
 
@@ -237,6 +243,7 @@ class TFRecordImageNetDataset:
         process_count: int = 1,
         length: Optional[int] = None,
         shuffle_buffer: int = 1024,
+        image_dtype=np.float32,
     ):
         import tensorflow as tf
 
@@ -247,6 +254,7 @@ class TFRecordImageNetDataset:
         if global_batch_size % process_count != 0:
             raise ValueError("global batch not divisible by process count")
         self._tf = tf
+        self._tf_image_dtype = tf.dtypes.as_dtype(np.dtype(image_dtype))
         self.files = files
         self.global_batch_size = global_batch_size
         self.local_batch_size = global_batch_size // process_count
@@ -307,6 +315,8 @@ class TFRecordImageNetDataset:
             image = tf.image.resize(image, (size, size))
         image = tf.cast(image, tf.float32) / 255.0
         image = (image - _MEAN) / _SD
+        # Stage at the model's compute dtype (bf16 halves host→HBM bytes).
+        image = tf.cast(image, self._tf_image_dtype)
         label = tf.cast(feats["image/class/label"], tf.int32)
         return image, label
 
@@ -359,7 +369,7 @@ class TFRecordImageNetDataset:
         # otherwise hang the pod in the eval psum.
         pad = tf.data.Dataset.from_tensors(
             (
-                tf.zeros((size, size, 3), tf.float32),
+                tf.zeros((size, size, 3), self._tf_image_dtype),
                 tf.zeros((), tf.int32),
                 tf.zeros((), tf.float32),
             )

@@ -1,0 +1,13 @@
+"""bench.py smoke: the driver's benchmark harness must stay runnable."""
+
+import numpy as np
+
+
+def test_run_bench_smoke(monkeypatch, mesh8):
+    monkeypatch.setenv("BENCH_DEPTH", "18")
+    monkeypatch.setenv("BENCH_IMAGE_SIZE", "16")
+    import bench
+
+    ips, n_dev = bench.run_bench(2, devices=2)
+    assert n_dev == 2
+    assert np.isfinite(ips) and ips > 0
