@@ -1,0 +1,55 @@
+# Reproducible TPU-VM training image.
+#
+# The reference ships four images: a control-plane image (conda + az CLI
+# + docker-in-docker, Docker/dockerfile:26-61) and three per-framework
+# GPU images pinning CUDA/cuDNN/MPI/Horovod (e.g.
+# HorovodTF/Docker/Dockerfile:5-58). On TPU the entire native tier those
+# images exist to pin (NCCL, MPI, Horovod, cuDNN) is replaced by
+# jax[tpu]+libtpu, so ONE image covers both roles: run it on a TPU VM
+# for training, or anywhere for the CPU-mesh smoke path
+# (XLA_FLAGS=--xla_force_host_platform_device_count=8).
+#
+#   make build   # docker build -t $DOCKER_REPOSITORY/ddl-tpu .
+#   make smoke   # 2-process CPU-mesh training inside the image
+#   make push    # push to the registry (reference 00_CreateImage cell 11)
+
+FROM python:3.12-slim-bookworm
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        git curl ca-certificates \
+    && rm -rf /var/lib/apt/lists/*
+
+# gcloud CLI — the control-plane role (reference Docker/dockerfile:49-54
+# installs az CLI + azcopy; gcloud covers both provisioning and storage).
+RUN curl -sSL https://sdk.cloud.google.com > /tmp/gcl \
+    && bash /tmp/gcl --install-dir=/opt --disable-prompts \
+    && rm /tmp/gcl
+ENV PATH="/opt/google-cloud-sdk/bin:${PATH}"
+
+WORKDIR /workspace
+
+# Pinned python environment (reference pins TF 1.9/Horovod 0.13.2 etc.;
+# here the equivalent contract is jax[tpu] + the input-pipeline deps).
+RUN pip install --no-cache-dir \
+        'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir \
+        flax optax orbax-checkpoint chex einops \
+        tensorflow-cpu pillow numpy pytest
+
+COPY pyproject.toml ./
+COPY distributeddeeplearning_tpu ./distributeddeeplearning_tpu
+COPY examples ./examples
+COPY tests ./tests
+COPY launch.py bench.py __graft_entry__.py ./
+RUN pip install --no-cache-dir -e .
+
+# Smoke default: the reference's local container test runs
+# `mpirun -np 2 … FAKE=True` (00_CreateImageAndTest cells 6-7); ours is
+# the launcher's 2-process CPU-mesh equivalent.
+CMD ["python", "launch.py", "--num-processes", "2", \
+     "--devices-per-process", "4", "--platform", "cpu", \
+     "--env", "FAKE=True", "--env", "FAKE_DATA_LENGTH=128", \
+     "--env", "EPOCHS=1", "--env", "BATCHSIZE=4", \
+     "--env", "IMAGE_SIZE=32", "--env", "NUM_CLASSES=8", \
+     "--env", "MODEL=resnet18", \
+     "examples/imagenet_keras_tpu.py"]

@@ -1,0 +1,84 @@
+# Build front-end — parity with the reference Makefile (Makefile:18-39)
+# and its generic build helper (include/build.mk:12-16).
+#
+# Typical flow (reference notebook order):
+#   make build smoke push      # 00_CreateImageAndTest
+#   make provision setup       # 01_CreateResources
+#   make submit stream         # 01_Train*
+#   make teardown
+#
+# Registry/infra knobs come from the environment or .env (dotenv), like
+# the reference's DOCKER_REPOSITORY/EXT_PWD exports (Makefile:22-29).
+
+DOCKER_REPOSITORY ?= local
+IMAGE             ?= $(DOCKER_REPOSITORY)/ddl-tpu
+TAG               ?= latest
+TPU               ?=
+ZONE              ?=
+BUCKET            ?=
+ACCELERATOR_TYPE  ?= v5litepod-8
+SCRIPT            ?= examples/imagenet_keras_tpu.py
+JOB               ?= ddl-train
+PY                ?= python
+
+.PHONY: build push run smoke test test-fast bench provision setup \
+        submit stream status stop teardown
+
+## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
+build:
+	docker build -t $(IMAGE):$(TAG) .
+
+push:
+	docker push $(IMAGE):$(TAG)
+
+run:	## run the image's default smoke command locally
+	docker run --rm -it $(IMAGE):$(TAG)
+
+## Local verification (reference's mpirun -np 2 smoke, no docker needed)
+smoke:
+	$(PY) launch.py --num-processes 2 --devices-per-process 4 \
+	    --platform cpu --timeout 540 \
+	    --env FAKE=True --env FAKE_DATA_LENGTH=128 --env EPOCHS=1 \
+	    --env BATCHSIZE=4 --env IMAGE_SIZE=32 --env NUM_CLASSES=8 \
+	    --env MODEL=resnet18 $(SCRIPT)
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q -k "not two_process"
+
+bench:
+	$(PY) bench.py
+
+## Cluster tier (reference 01_CreateResources / 01_Train*)
+provision:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.provision \
+	    pod-create --tpu $(TPU) --zone $(ZONE) \
+	    --accelerator-type $(ACCELERATOR_TYPE)
+
+setup:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.provision \
+	    setup --tpu $(TPU) --zone $(ZONE) \
+	    $(if $(BUCKET),--bucket $(BUCKET),)
+
+submit:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
+	    run --tpu $(TPU) --zone $(ZONE) --job $(JOB) --detach \
+	    --manifest $(JOB).json $(SCRIPT)
+
+stream:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
+	    stream --tpu $(TPU) --zone $(ZONE) --job $(JOB)
+
+status:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
+	    status --tpu $(TPU) --zone $(ZONE) --job $(JOB)
+
+stop:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
+	    stop --tpu $(TPU) --zone $(ZONE) --job $(JOB)
+
+teardown:
+	$(PY) -m distributeddeeplearning_tpu.orchestration.provision \
+	    pod-delete --tpu $(TPU) --zone $(ZONE)

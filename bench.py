@@ -103,6 +103,9 @@ def main():
     batches = (256, 128, 64, 32)
     if "BENCH_BATCH" in os.environ:
         batches = (int(os.environ["BENCH_BATCH"]),)
+    depth = int(os.environ.get("BENCH_DEPTH", "50"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    canonical = depth == 50 and image_size == 224
     for per_device_batch in batches:
         try:
             ips, n_dev = run_bench(per_device_batch, profile_dir=profile_dir)
@@ -113,7 +116,11 @@ def main():
                 "images_per_sec_per_device": round(per_chip, 1),
                 "platform": jax.devices()[0].platform,
                 "baseline_images_per_sec_per_device": REFERENCE_IMAGES_PER_SEC_PER_DEVICE,
+                "model_depth": depth,
+                "image_size": image_size,
             }
+            if not canonical:
+                detail["smoke_overrides"] = True
             if scaling and n_dev > 1:
                 # Scaling-efficiency path (BASELINE >90% target, 8→64):
                 # images/sec/chip at 1 device vs all attached devices. A
@@ -127,12 +134,20 @@ def main():
             print(
                 json.dumps(
                     {
-                        "metric": "resnet50_synthetic_train_images_per_sec",
+                        "metric": (
+                            "resnet50_synthetic_train_images_per_sec"
+                            if canonical
+                            else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
+                        ),
                         "value": round(ips, 1),
                         "unit": "images/sec",
+                        # vs_baseline only means something for the
+                        # canonical ResNet50@224 protocol
                         "vs_baseline": round(
                             per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3
-                        ),
+                        )
+                        if canonical
+                        else 0.0,
                         "detail": detail,
                     }
                 )

@@ -186,7 +186,11 @@ class ImageFolderDataset:
             rng = np.random.default_rng(
                 (self.seed, epoch_index, int(sample_idx), self.process_index)
             )
-            return _load_image(path, self.image_size, self.train, rng), label
+            img = _load_image(path, self.image_size, self.train, rng)
+            # Cast per-image inside the pool: stack() below then builds
+            # the batch directly at the staging dtype (bf16 = half the
+            # allocation), instead of a serial full-batch astype.
+            return img.astype(self.image_dtype, copy=False), label
 
         with concurrent.futures.ThreadPoolExecutor(self.num_workers) as pool:
             for step in range(self.steps_per_epoch):
@@ -195,9 +199,7 @@ class ImageFolderDataset:
                         (j, int(local[(step * b + j) % len(local)])) for j in range(b)
                     ]
                     results = list(pool.map(decode, idxs))
-                    images = np.stack([r[0] for r in results]).astype(
-                        self.image_dtype, copy=False
-                    )
+                    images = np.stack([r[0] for r in results])
                     labels = np.asarray([r[1] for r in results], np.int32)
                     yield images, labels
                 else:
@@ -210,9 +212,7 @@ class ImageFolderDataset:
                         for j, s in enumerate(slots)
                     ]
                     results = list(pool.map(decode, idxs))
-                    images = np.stack([r[0] for r in results]).astype(
-                        self.image_dtype, copy=False
-                    )
+                    images = np.stack([r[0] for r in results])
                     labels = np.asarray([r[1] for r in results], np.int32)
                     yield images, labels, weights
 

@@ -222,6 +222,61 @@ class _ChildFailed(Exception):
 # TPU pod mode (job submission — 01_Train*.ipynb cell 15/19 equivalent)
 # ---------------------------------------------------------------------------
 
+def build_remote_command(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    env: Optional[Dict[str, str]] = None,
+    workdir: str = "~/ddl",
+    python: str = "python3",
+    detach_job: Optional[str] = None,
+    image: Optional[str] = None,
+) -> str:
+    """The shell line every TPU-VM worker executes.
+
+    One construction point for both launch modes (foreground and the
+    submitter's detached mode) so quoting/env/workdir semantics cannot
+    drift. Mirrors the reference's job ``commandLine`` (``01_Train*.
+    ipynb`` cell 15): env exports (mpirun ``-x``), then ``python -u
+    <script>``. ``DISTRIBUTED=True`` switches ``maybe_initialize`` onto
+    the TPU-metadata autodetect path.
+
+    ``image``: run inside the prebuilt training container instead of the
+    host python (pairs with ``provision setup --image``); ``--privileged
+    --net=host`` exposes the TPU devices and the pod network, and
+    ``workdir`` is mounted at ``/workspace`` (code + data + logs).
+    """
+    exports = {"DISTRIBUTED": "True", **(env or {})}
+    export_str = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(exports.items())
+    )
+    args_str = " ".join(shlex.quote(a) for a in script_args)
+    if image:
+        docker_env = " ".join(
+            f"-e {shlex.quote(k)}={shlex.quote(v)}"
+            for k, v in sorted(exports.items())
+        )
+        inner = (
+            f"sudo docker run --rm --privileged --net=host {docker_env} "
+            f"-v $(cd {workdir} && pwd):/workspace -w /workspace "
+            f"{shlex.quote(image)} "
+            f"{python} -u {shlex.quote(script)} {args_str}"
+        ).strip()
+    else:
+        inner = (
+            f"{export_str} {python} -u {shlex.quote(script)} {args_str}"
+        ).strip()
+    if detach_job:
+        job = shlex.quote(detach_job)
+        return (
+            f"cd {workdir} && mkdir -p logs && "
+            f"nohup {inner} > logs/{job}.log 2>&1 & "
+            f"echo $! > logs/{job}.pid; "
+            f"echo submitted {job} pid $(cat logs/{job}.pid)"
+        )
+    return f"cd {workdir} && {inner}"
+
+
 def build_pod_command(
     script: str,
     script_args: Sequence[str] = (),
@@ -233,22 +288,19 @@ def build_pod_command(
     env: Optional[Dict[str, str]] = None,
     workdir: str = "~/ddl",
     python: str = "python3",
+    detach_job: Optional[str] = None,
+    image: Optional[str] = None,
 ) -> List[str]:
-    """Build the ``gcloud … ssh --worker=all`` argv for a pod-wide run.
-
-    The remote command mirrors the reference's job ``commandLine``
-    (``01_Train*.ipynb`` cell 15): env exports (mpirun ``-x``), then
-    ``python -u <script>``. ``DISTRIBUTED=True`` switches
-    ``maybe_initialize`` onto the TPU-metadata autodetect path.
-    """
-    exports = {"DISTRIBUTED": "True", **(env or {})}
-    export_str = " ".join(
-        f"{k}={shlex.quote(v)}" for k, v in sorted(exports.items())
+    """Build the ``gcloud … ssh --worker=all`` argv for a pod-wide run."""
+    remote = build_remote_command(
+        script,
+        script_args,
+        env=env,
+        workdir=workdir,
+        python=python,
+        detach_job=detach_job,
+        image=image,
     )
-    remote = (
-        f"cd {workdir} && {export_str} {python} -u "
-        f"{shlex.quote(script)} {' '.join(shlex.quote(a) for a in script_args)}"
-    ).strip()
     cmd = [
         "gcloud",
         "compute",

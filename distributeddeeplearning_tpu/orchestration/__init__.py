@@ -1,0 +1,30 @@
+"""Orchestration layer — the reference's notebook/CLI tier, TPU-native.
+
+Maps the reference's Azure Batch AI flow (SURVEY.md §1 L4/L5) onto
+Cloud TPU:
+
+| Reference | Here |
+|---|---|
+| ``01_CreateResources.ipynb`` (storage, data upload, NFS, cluster) | ``provision.py`` (GCS bucket, data staging, pod slice, worker setup) |
+| ``01_Train*.ipynb`` cells 11-26 (job JSON, submit, poll, stream) | ``submit.py`` (manifest, pod-wide launch, per-worker log streaming) |
+| ``Horovod*/00_CreateImageAndTest.ipynb`` (build, local smoke, push) | ``Makefile`` targets ``build`` / ``smoke`` / ``push`` |
+| ``Docker/dockerfile`` control-plane image | repo-root ``Dockerfile`` (TPU-VM image) |
+| ``.env`` via python-dotenv | ``utils/env.py`` (same file format) |
+
+Every command that would touch gcloud supports ``--dry-run`` printing
+the exact command line, which is also how the layer is unit-tested in
+an egress-free environment.
+"""
+
+from distributeddeeplearning_tpu.orchestration.provision import (  # noqa: F401
+    pod_create_command,
+    pod_delete_command,
+    pod_describe_command,
+    setup_commands,
+    storage_commands,
+)
+from distributeddeeplearning_tpu.orchestration.submit import (  # noqa: F401
+    build_manifest,
+    stream_command,
+    submit_commands,
+)

@@ -1,0 +1,302 @@
+"""Resource provisioning — the ``01_CreateResources.ipynb`` equivalent.
+
+The reference notebook (44 cells) creates, in order: a resource group +
+storage account + file share (cells 10-15), uploads the dataset (cells
+22-24), an NFS file server whose nodeprep pulls and untars the data
+(cells 26-35), and a fixed-size Batch AI GPU cluster with those mounts
+(cell 39). The TPU-native shape of the same capability:
+
+* **storage**: a GCS bucket + ``gcloud storage rsync`` of the prepared
+  TFRecord shards (``data/prepare.py`` writes them; no NFS middleman —
+  TPU-VM workers read GCS directly or via gcsfuse).
+* **pod**: one ``gcloud compute tpus tpu-vm create`` for an N-chip pod
+  slice — there is no separate cluster/nodecount/hostfile machinery;
+  the pod IS the cluster, and JAX's coordination service replaces MPI.
+* **setup**: the ``nodeprep.sh``/``docker.service`` analogue — a
+  ``--worker=all`` bring-up that installs the wheel (or pulls the
+  image), mounts the data, and smoke-imports jax on every worker.
+
+State (project/zone/names) lives in ``.env`` exactly like the
+reference's dotenv workflow (``common/utils.py``, notebook cell 3).
+
+CLI::
+
+    python -m distributeddeeplearning_tpu.orchestration.provision \
+        storage --bucket gs://my-imagenet --data tfrecords/ [--dry-run]
+    ... pod-create --tpu ddl-pod --zone us-west4-a \
+        --accelerator-type v5litepod-64 [--dry-run]
+    ... setup --tpu ddl-pod --zone us-west4-a --bucket gs://my-imagenet
+    ... pod-status | pod-delete ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from distributeddeeplearning_tpu.utils.env import dotenv_for, load_env_file, set_key
+
+#: default TPU software version for v5e pods (override with --version)
+DEFAULT_RUNTIME = "v2-alpha-tpuv5-lite"
+
+
+def _gcloud(*args: str, project: Optional[str] = None) -> List[str]:
+    cmd = ["gcloud", *args]
+    if project:
+        cmd.append(f"--project={project}")
+    return cmd
+
+
+def storage_commands(
+    bucket: str,
+    data_dir: Optional[str] = None,
+    *,
+    location: str = "us-west4",
+    project: Optional[str] = None,
+) -> List[List[str]]:
+    """Bucket create + dataset staging (reference cells 10-15, 22-24).
+
+    ``gcloud storage rsync`` replaces azcopy; the bucket replaces both
+    the file share and the NFS server (TPU workers stream TFRecords
+    straight from GCS at pod rate — SURVEY §7 hard part (a))."""
+    if not bucket.startswith("gs://"):
+        bucket = f"gs://{bucket}"
+    cmds = [
+        _gcloud(
+            "storage", "buckets", "create", bucket,
+            f"--location={location}", project=project,
+        )
+    ]
+    if data_dir:
+        cmds.append(
+            _gcloud(
+                "storage", "rsync", "--recursive", data_dir,
+                f"{bucket.rstrip('/')}/data", project=project,
+            )
+        )
+    return cmds
+
+
+def pod_create_command(
+    tpu: str,
+    zone: str,
+    *,
+    accelerator_type: str = "v5litepod-8",
+    version: str = DEFAULT_RUNTIME,
+    project: Optional[str] = None,
+    spot: bool = False,
+) -> List[str]:
+    """Pod-slice creation (reference cell 39's ``az batchai cluster
+    create --min N --max N`` — fixed-size by construction on TPU)."""
+    cmd = _gcloud(
+        "compute", "tpus", "tpu-vm", "create", tpu,
+        f"--zone={zone}",
+        f"--accelerator-type={accelerator_type}",
+        f"--version={version}",
+        project=project,
+    )
+    if spot:
+        cmd.append("--spot")
+    return cmd
+
+
+def pod_describe_command(
+    tpu: str, zone: str, project: Optional[str] = None
+) -> List[str]:
+    """Cluster status (reference cells 41-43)."""
+    return _gcloud(
+        "compute", "tpus", "tpu-vm", "describe", tpu, f"--zone={zone}",
+        project=project,
+    )
+
+
+def pod_delete_command(
+    tpu: str, zone: str, project: Optional[str] = None
+) -> List[str]:
+    """Teardown (reference 01_Train*.ipynb cells 28-37 delete job /
+    cluster / workspace / group — one command here)."""
+    return _gcloud(
+        "compute", "tpus", "tpu-vm", "delete", tpu, f"--zone={zone}",
+        "--quiet", project=project,
+    )
+
+
+def setup_commands(
+    tpu: str,
+    zone: str,
+    *,
+    bucket: Optional[str] = None,
+    image: Optional[str] = None,
+    repo_dir: str = ".",
+    workdir: str = "~/ddl",
+    project: Optional[str] = None,
+) -> List[List[str]]:
+    """Worker bring-up — the ``nodeprep.sh`` + ``docker.service`` analogue
+    (reference cluster_config; SURVEY §2 "Cluster node setup") plus the
+    script upload the reference does at submit time (``01_Train*.ipynb``
+    cell 11, ``az storage file upload`` of src/ to the share).
+
+    Stages the framework checkout into ``workdir`` on every worker via
+    scp, then either installs the pip environment directly (and the
+    package itself, editable) or (``image=``) pulls the prebuilt Docker
+    image — ``submit --image`` then runs inside that container with
+    ``workdir`` mounted. Ends with a JAX device-count smoke — the
+    reference's de-facto acceptance check (NCCL_DEBUG ring lines →
+    here, global device count)."""
+    ssh_steps = [f"mkdir -p {workdir} {workdir}/logs"]
+    cmds = [
+        _gcloud(
+            "compute", "tpus", "tpu-vm", "ssh", tpu,
+            f"--zone={zone}", "--worker=all",
+            f"--command={ssh_steps[0]}",
+            project=project,
+        ),
+        # Code staging (reference cell 11's upload-scripts-to-share):
+        _gcloud(
+            "compute", "tpus", "tpu-vm", "scp", "--recurse",
+            f"{repo_dir.rstrip('/')}/.", f"{tpu}:{workdir}",
+            f"--zone={zone}", "--worker=all",
+            project=project,
+        ),
+    ]
+    if image:
+        ssh_steps = [f"sudo docker pull {image}"]
+    else:
+        ssh_steps = [
+            "pip install -q 'jax[tpu]' flax optax orbax-checkpoint "
+            "tensorflow-cpu pillow einops && "
+            f"pip install -q -e {workdir}",
+        ]
+    if bucket:
+        if not bucket.startswith("gs://"):
+            bucket = f"gs://{bucket}"
+        ssh_steps.append(
+            f"gcloud storage rsync --recursive {bucket.rstrip('/')}/data "
+            f"{workdir}/data"
+        )
+    if not image:
+        ssh_steps.append(
+            'python3 -c "import jax; jax.distributed.initialize(); '
+            "print('worker', jax.process_index(), 'of', jax.process_count(), "
+            "'sees', jax.device_count(), 'global devices')\""
+        )
+    cmds.extend(
+        _gcloud(
+            "compute", "tpus", "tpu-vm", "ssh", tpu,
+            f"--zone={zone}", "--worker=all",
+            f"--command={step}",
+            project=project,
+        )
+        for step in ssh_steps
+    )
+    return cmds
+
+
+def run_commands(
+    cmds: Sequence[Sequence[str]], dry_run: bool, sink=None
+) -> int:
+    sink = sink or sys.stdout
+    for cmd in cmds:
+        sink.write(" ".join(shlex.quote(c) for c in cmd) + "\n")
+        if not dry_run:
+            rc = subprocess.call(list(cmd))
+            if rc != 0:
+                return rc
+    return 0
+
+
+def _env_default(key: str, env_path: Optional[str]) -> Optional[str]:
+    return load_env_file(dotenv_for(env_path)).get(key)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="provision",
+        description="Provision GCS storage and a TPU pod slice "
+        "(01_CreateResources equivalent).",
+    )
+    ap.add_argument("--env-file", default=None, help=".env with defaults")
+    ap.add_argument("--project", default=None)
+    ap.add_argument("--dry-run", action="store_true")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    st = sub.add_parser("storage", help="create bucket + stage dataset")
+    st.add_argument("--bucket", required=True)
+    st.add_argument("--data", default=None, help="local prepared-data dir")
+    st.add_argument("--location", default="us-west4")
+
+    for name, help_ in (
+        ("pod-create", "create the pod slice"),
+        ("pod-status", "describe the pod"),
+        ("pod-delete", "tear the pod down"),
+        ("setup", "bring up every worker (nodeprep equivalent)"),
+    ):
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--tpu", default=None)
+        p.add_argument("--zone", default=None)
+        if name == "pod-create":
+            p.add_argument("--accelerator-type", default="v5litepod-8")
+            p.add_argument("--version", default=DEFAULT_RUNTIME)
+            p.add_argument("--spot", action="store_true")
+        if name == "setup":
+            p.add_argument("--bucket", default=None)
+            p.add_argument("--image", default=None)
+            p.add_argument("--repo-dir", default=".")
+
+    args = ap.parse_args(argv)
+    project = args.project or _env_default("PROJECT", args.env_file)
+
+    if args.cmd == "storage":
+        cmds = storage_commands(
+            args.bucket, args.data, location=args.location, project=project
+        )
+        if not args.dry_run:
+            set_key(dotenv_for(args.env_file), "BUCKET", args.bucket)
+        return run_commands(cmds, args.dry_run)
+
+    tpu = args.tpu or _env_default("TPU_NAME", args.env_file)
+    zone = args.zone or _env_default("ZONE", args.env_file)
+    if not tpu or not zone:
+        ap.error("--tpu/--zone required (or TPU_NAME/ZONE in .env)")
+    if args.cmd == "pod-create":
+        if not args.dry_run:
+            env = dotenv_for(args.env_file)
+            set_key(env, "TPU_NAME", tpu)
+            set_key(env, "ZONE", zone)
+        return run_commands(
+            [
+                pod_create_command(
+                    tpu,
+                    zone,
+                    accelerator_type=args.accelerator_type,
+                    version=args.version,
+                    project=project,
+                    spot=args.spot,
+                )
+            ],
+            args.dry_run,
+        )
+    if args.cmd == "pod-status":
+        return run_commands(
+            [pod_describe_command(tpu, zone, project=project)], args.dry_run
+        )
+    if args.cmd == "pod-delete":
+        return run_commands(
+            [pod_delete_command(tpu, zone, project=project)], args.dry_run
+        )
+    if args.cmd == "setup":
+        return run_commands(
+            setup_commands(
+                tpu, zone, bucket=args.bucket, image=args.image,
+                repo_dir=args.repo_dir, project=project,
+            ),
+            args.dry_run,
+        )
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,241 @@
+"""Job submission + log streaming — the ``01_Train*.ipynb`` equivalent.
+
+The reference builds a Batch AI job JSON (cell 15: nodeCount, the full
+``mpirun --hostfile … python -u <script>`` command line, input/output
+mounts, container image), submits it (cell 19), polls (cell 21), and
+streams stdout/stderr from the cluster (cells 25-26). TPU-native:
+
+* the **manifest** is the same idea — one JSON recording exactly what
+  ran (script, env, pod, command) written via
+  ``utils.env.write_json_to_file`` (reference ``common/utils.py:28-31``);
+* **submit** wraps the pod-wide ssh launch
+  (``launch.build_pod_command``): foreground (output streams back
+  through ssh, the smoke-test mode) or ``--detach`` (nohup into
+  ``~/ddl/logs/<job>.log`` on every worker, the cluster mode);
+* **stream** tails a detached job's log from any worker —
+  ``az batchai job file stream`` parity;
+* **status/stop** poll or kill the detached process group.
+
+CLI::
+
+    python -m distributeddeeplearning_tpu.orchestration.submit \
+        run --tpu ddl-pod --zone us-west4-a [--detach] \
+        [--env FAKE=True] examples/imagenet_keras_tpu.py [args…]
+    ... stream --tpu ddl-pod --zone us-west4-a --job <name> [--worker 0]
+    ... status|stop --tpu ddl-pod --zone us-west4-a --job <name>
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from distributeddeeplearning_tpu.launch import build_pod_command
+from distributeddeeplearning_tpu.utils.env import (
+    dotenv_for,
+    load_env_file,
+    write_json_to_file,
+)
+
+
+def build_manifest(
+    job: str,
+    script: str,
+    script_args: Sequence[str],
+    *,
+    tpu: str,
+    zone: str,
+    env: Dict[str, str],
+    detach: bool,
+    command: Sequence[str],
+) -> dict:
+    """The job-JSON record (reference cell 15's ``job.json`` via
+    ``write_json_to_file``)."""
+    return {
+        "job": job,
+        "script": script,
+        "script_args": list(script_args),
+        "tpu": tpu,
+        "zone": zone,
+        "env": dict(env),
+        "detach": detach,
+        "command": " ".join(shlex.quote(c) for c in command),
+    }
+
+
+def submit_commands(
+    job: str,
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    tpu: str,
+    zone: str,
+    project: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    detach: bool = False,
+    image: Optional[str] = None,
+    workdir: str = "~/ddl",
+) -> List[str]:
+    """The gcloud argv for the run (remote line built by
+    ``launch.build_remote_command`` — one construction point for every
+    launch mode). Detached mode nohups the training process on every
+    worker with output into ``logs/<job>.log`` (the stdOutErrPathPrefix
+    role) and records its pid for status/stop. ``image`` runs inside the
+    container that ``provision setup --image`` pulled."""
+    return build_pod_command(
+        script,
+        script_args,
+        tpu=tpu,
+        zone=zone,
+        project=project,
+        env=env,
+        workdir=workdir,
+        detach_job=job if detach else None,
+        image=image,
+    )
+
+
+def stream_command(
+    job: str,
+    *,
+    tpu: str,
+    zone: str,
+    worker: str = "0",
+    project: Optional[str] = None,
+    workdir: str = "~/ddl",
+    follow: bool = True,
+) -> List[str]:
+    """``az batchai job file stream stdout.txt`` parity (cells 25-26)."""
+    tail = f"tail {'-f ' if follow else ''}-n +1 {workdir}/logs/{job}.log"
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu,
+        *([f"--project={project}"] if project else []),
+        f"--zone={zone}", f"--worker={worker}", f"--command={tail}",
+    ]
+
+
+def control_command(
+    job: str,
+    action: str,
+    *,
+    tpu: str,
+    zone: str,
+    project: Optional[str] = None,
+    workdir: str = "~/ddl",
+) -> List[str]:
+    """status (poll, reference cell 21) / stop (kill) for detached jobs."""
+    if action == "status":
+        remote = (
+            f"test -f {workdir}/logs/{job}.pid && "
+            f"(kill -0 $(cat {workdir}/logs/{job}.pid) 2>/dev/null "
+            f"&& echo {job}: running pid $(cat {workdir}/logs/{job}.pid) "
+            f"|| echo {job}: finished) || echo {job}: unknown"
+        )
+    elif action == "stop":
+        remote = (
+            f"test -f {workdir}/logs/{job}.pid && "
+            f"kill $(cat {workdir}/logs/{job}.pid) 2>/dev/null; "
+            f"echo {job}: stopped"
+        )
+    else:
+        raise ValueError(action)
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu,
+        *([f"--project={project}"] if project else []),
+        f"--zone={zone}", "--worker=all", f"--command={remote}",
+    ]
+
+
+def _parse_env(pairs: Sequence[str]) -> Dict[str, str]:
+    out = {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(f"--env expects KEY=VALUE, got {p!r}")
+        k, v = p.split("=", 1)
+        out[k] = v
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="submit",
+        description="Submit/stream/control training jobs on a TPU pod "
+        "(01_Train* equivalent).",
+    )
+    ap.add_argument("--env-file", default=None)
+    ap.add_argument("--project", default=None)
+    ap.add_argument("--tpu", default=None)
+    ap.add_argument("--zone", default=None)
+    ap.add_argument("--dry-run", action="store_true")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="submit a training run")
+    run.add_argument("--job", default=None, help="job name (default: auto)")
+    run.add_argument("--detach", action="store_true")
+    run.add_argument("--env", "-x", action="append", default=[])
+    run.add_argument(
+        "--image",
+        default=None,
+        help="run inside this container (pair with provision setup --image)",
+    )
+    run.add_argument("--manifest", default=None, help="write job JSON here")
+    run.add_argument("script")
+    run.add_argument("script_args", nargs=argparse.REMAINDER)
+
+    stp = sub.add_parser("stream", help="stream a detached job's log")
+    stp.add_argument("--job", required=True)
+    stp.add_argument("--worker", default="0")
+    stp.add_argument("--no-follow", action="store_true")
+
+    for name in ("status", "stop"):
+        c = sub.add_parser(name)
+        c.add_argument("--job", required=True)
+
+    args = ap.parse_args(argv)
+    envfile = load_env_file(dotenv_for(args.env_file))
+    tpu = args.tpu or envfile.get("TPU_NAME")
+    zone = args.zone or envfile.get("ZONE")
+    project = args.project or envfile.get("PROJECT")
+    if not tpu or not zone:
+        ap.error("--tpu/--zone required (or TPU_NAME/ZONE in .env)")
+
+    if args.cmd == "run":
+        job = args.job or f"job-{int(time.time())}"
+        env = _parse_env(args.env)
+        cmd = submit_commands(
+            job, args.script, args.script_args,
+            tpu=tpu, zone=zone, project=project, env=env,
+            detach=args.detach, image=args.image,
+        )
+        manifest = build_manifest(
+            job, args.script, args.script_args,
+            tpu=tpu, zone=zone, env=env, detach=args.detach, command=cmd,
+        )
+        if args.manifest:
+            write_json_to_file(manifest, args.manifest)
+        print(" ".join(shlex.quote(c) for c in cmd))
+        if args.dry_run:
+            return 0
+        return subprocess.call(cmd)
+
+    if args.cmd == "stream":
+        cmd = stream_command(
+            args.job, tpu=tpu, zone=zone, worker=args.worker,
+            project=project, follow=not args.no_follow,
+        )
+    else:
+        cmd = control_command(
+            args.job, args.cmd, tpu=tpu, zone=zone, project=project
+        )
+    print(" ".join(shlex.quote(c) for c in cmd))
+    if args.dry_run:
+        return 0
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

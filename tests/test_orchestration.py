@@ -1,0 +1,173 @@
+"""Orchestration-layer tests: command construction + CLI dry runs.
+
+The reference's L4 tier (provision / submit / stream notebooks) has no
+tests at all; here every gcloud command line is asserted, and the CLIs
+run end-to-end in --dry-run mode (which is also the documented way to
+inspect what would run — docs/ORCHESTRATION.md).
+"""
+
+import json
+
+import pytest
+
+from distributeddeeplearning_tpu.orchestration import provision, submit
+
+
+def test_storage_commands():
+    cmds = provision.storage_commands(
+        "my-imagenet", "tfrecords/", location="us-west4", project="proj"
+    )
+    assert cmds[0][:4] == ["gcloud", "storage", "buckets", "create"]
+    assert "gs://my-imagenet" in cmds[0]
+    assert "--project=proj" in cmds[0]
+    assert cmds[1][:3] == ["gcloud", "storage", "rsync"]
+    assert "gs://my-imagenet/data" in cmds[1]
+
+
+def test_pod_lifecycle_commands():
+    c = provision.pod_create_command(
+        "pod", "us-west4-a", accelerator_type="v5litepod-64", spot=True
+    )
+    joined = " ".join(c)
+    assert "tpu-vm create pod" in joined
+    assert "--accelerator-type=v5litepod-64" in c
+    assert "--spot" in c
+    assert "--zone=us-west4-a" in c
+    d = provision.pod_describe_command("pod", "z")
+    assert "describe" in d
+    x = provision.pod_delete_command("pod", "z")
+    assert "delete" in x and "--quiet" in x
+
+
+def test_setup_commands_pip_and_image():
+    cmds = provision.setup_commands("pod", "z", bucket="my-imagenet")
+    joined = [" ".join(c) for c in cmds]
+    assert all("--worker=all" in j for j in joined)
+    # code staging (reference 01_Train cell 11's upload-scripts step)
+    assert any(" scp " in f" {j} " and "pod:~/ddl" in j for j in joined)
+    assert any("pip install" in j and "-e ~/ddl" in j for j in joined)
+    assert any("gs://my-imagenet/data" in j for j in joined)
+    assert "jax.distributed.initialize" in joined[-1]  # acceptance check
+    img = provision.setup_commands("pod", "z", image="gcr.io/p/ddl-tpu")
+    assert any("docker pull gcr.io/p/ddl-tpu" in " ".join(c) for c in img)
+    assert not any("pip install" in " ".join(c) for c in img)
+
+
+def test_submit_inside_container_matches_setup_image():
+    cmd = submit.submit_commands(
+        "j2", "examples/imagenet_keras_tpu.py", (),
+        tpu="pod", zone="z", detach=True, image="gcr.io/p/ddl-tpu",
+    )
+    joined = " ".join(cmd)
+    assert "docker run --rm --privileged --net=host" in joined
+    assert "gcr.io/p/ddl-tpu" in joined
+    assert "-e DISTRIBUTED=True" in joined
+    assert "logs/j2.log" in joined  # detach still logs on the host side
+
+
+def test_provision_cli_dry_run(capsys, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # .env writes stay in tmp
+    rc = provision.main(
+        ["--dry-run", "pod-create", "--tpu", "pod", "--zone", "z"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm create pod" in out
+
+
+def test_provision_cli_env_defaults(capsys, tmp_path):
+    env = tmp_path / ".env"
+    env.write_text("TPU_NAME=envpod\nZONE=envzone\nPROJECT=envproj\n")
+    rc = provision.main(
+        ["--env-file", str(env), "--dry-run", "pod-status"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "describe envpod" in out
+    assert "--zone=envzone" in out
+    assert "--project=envproj" in out
+
+
+def test_submit_foreground_and_detached():
+    fg = submit.submit_commands(
+        "j1", "examples/imagenet_keras_tpu.py", ("--x",),
+        tpu="pod", zone="z", env={"FAKE": "True"},
+    )
+    joined = " ".join(fg)
+    assert "--worker=all" in joined
+    assert "DISTRIBUTED=True" in joined and "FAKE=True" in joined
+    assert "python3 -u examples/imagenet_keras_tpu.py" in joined
+
+    det = submit.submit_commands(
+        "j1", "train.py", (), tpu="pod", zone="z", detach=True,
+    )
+    joined = " ".join(det)
+    assert "nohup" in joined
+    assert "logs/j1.log" in joined
+    assert "logs/j1.pid" in joined
+
+
+def test_stream_and_control_commands():
+    s = submit.stream_command("j1", tpu="pod", zone="z", worker="3")
+    assert "--worker=3" in s
+    assert any("tail -f" in c and "logs/j1.log" in c for c in s)
+    s2 = submit.stream_command("j1", tpu="pod", zone="z", follow=False)
+    assert not any("tail -f" in c for c in s2)
+    st = submit.control_command("j1", "status", tpu="pod", zone="z")
+    assert any("kill -0" in c for c in st)
+    sp = submit.control_command("j1", "stop", tpu="pod", zone="z")
+    assert any("kill $(cat" in c for c in sp)
+    with pytest.raises(ValueError):
+        submit.control_command("j1", "bogus", tpu="pod", zone="z")
+
+
+def test_submit_cli_writes_manifest(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    manifest = tmp_path / "job.json"
+    rc = submit.main(
+        [
+            "--tpu", "pod", "--zone", "z", "--dry-run",
+            "run", "--job", "rn50", "--detach",
+            "--env", "EPOCHS=90",
+            "--manifest", str(manifest),
+            "examples/imagenet_keras_tpu.py",
+        ]
+    )
+    assert rc == 0
+    data = json.loads(manifest.read_text())
+    assert data["job"] == "rn50"
+    assert data["tpu"] == "pod"
+    assert data["env"] == {"EPOCHS": "90"}
+    assert data["detach"] is True
+    assert "nohup" in data["command"]
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh pod" in out
+
+
+def test_makefile_targets_exist():
+    import os, re, subprocess, sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(repo, "Makefile")).read()
+    for target in (
+        "build", "push", "run", "smoke", "test", "bench",
+        "provision", "setup", "submit", "stream", "status", "stop",
+        "teardown",
+    ):
+        assert re.search(rf"^{target}:", text, re.M), target
+    # make -n parses the file and expands a cluster target
+    res = subprocess.run(
+        ["make", "-n", "submit", "TPU=pod", "ZONE=z", f"PY={sys.executable}"],
+        cwd=repo, capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "orchestration.submit" in res.stdout
+
+
+def test_dockerfile_mentions_tpu_stack():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(repo, "Dockerfile")).read()
+    assert "jax[tpu]" in text
+    assert "launch.py" in text  # smoke CMD = the 2-process run
