@@ -52,33 +52,35 @@ bench:
 	$(PY) bench.py
 
 ## Cluster tier (reference 01_CreateResources / 01_Train*)
+# --tpu/--zone live on the PARENT parser (before the subcommand) and are
+# only passed when set, so TPU_NAME/ZONE from .env keep working.
+TPU_FLAGS = $(if $(TPU),--tpu $(TPU),) $(if $(ZONE),--zone $(ZONE),)
+
 provision:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.provision \
-	    pod-create --tpu $(TPU) --zone $(ZONE) \
-	    --accelerator-type $(ACCELERATOR_TYPE)
+	    $(TPU_FLAGS) pod-create --accelerator-type $(ACCELERATOR_TYPE)
 
 setup:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.provision \
-	    setup --tpu $(TPU) --zone $(ZONE) \
-	    $(if $(BUCKET),--bucket $(BUCKET),)
+	    $(TPU_FLAGS) setup $(if $(BUCKET),--bucket $(BUCKET),)
 
 submit:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
-	    run --tpu $(TPU) --zone $(ZONE) --job $(JOB) --detach \
+	    $(TPU_FLAGS) run --job $(JOB) --detach \
 	    --manifest $(JOB).json $(SCRIPT)
 
 stream:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
-	    stream --tpu $(TPU) --zone $(ZONE) --job $(JOB)
+	    $(TPU_FLAGS) stream --job $(JOB)
 
 status:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
-	    status --tpu $(TPU) --zone $(ZONE) --job $(JOB)
+	    $(TPU_FLAGS) status --job $(JOB)
 
 stop:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.submit \
-	    stop --tpu $(TPU) --zone $(ZONE) --job $(JOB)
+	    $(TPU_FLAGS) stop --job $(JOB)
 
 teardown:
 	$(PY) -m distributeddeeplearning_tpu.orchestration.provision \
-	    pod-delete --tpu $(TPU) --zone $(ZONE)
+	    $(TPU_FLAGS) pod-delete

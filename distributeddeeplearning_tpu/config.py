@@ -49,6 +49,9 @@ class TrainConfig:
     num_classes: int = 1000
     image_size: int = 224
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+    # Attention implementation for attention models (ViT):
+    # "xla" einsum | "pallas" flash kernel | "ring" sequence-parallel.
+    attn_impl: str = "xla"
 
     # Optimization — reference constants: LR 0.001 × world size
     # (TF :154, PyTorch :333), momentum 0.9, L2 5e-5 (Keras :97-116),
@@ -131,6 +134,8 @@ class TrainConfig:
             kw["num_workers"] = int(e["NUM_WORKERS"])
         if "MODEL" in e:
             kw["model"] = e["MODEL"]
+        if "ATTN_IMPL" in e:
+            kw["attn_impl"] = e["ATTN_IMPL"]
         if "SEED" in e:
             kw["seed"] = int(e["SEED"])
         # Smoke-test knobs (not in the reference contract): shrink the

@@ -52,7 +52,10 @@ class Estimator:
         if isinstance(model_fn, str):
             name = model_fn
             model_fn = lambda cfg: get_model(
-                name, num_classes=cfg.num_classes, dtype=cfg.compute_dtype
+                name,
+                num_classes=cfg.num_classes,
+                dtype=cfg.compute_dtype,
+                attn_impl=cfg.attn_impl,
             )
         self.model = model_fn(self.config)
         self._state: Optional[TrainState] = None

@@ -34,6 +34,7 @@ class Model:
                 module_or_name,
                 num_classes=self.config.num_classes,
                 dtype=self.config.compute_dtype,
+                attn_impl=self.config.attn_impl,
             )
             if isinstance(module_or_name, str)
             else module_or_name

@@ -263,11 +263,20 @@ def build_remote_command(
             f"{python} -u {shlex.quote(script)} {args_str}"
         ).strip()
     else:
+        # `env` prefix: plain K=V assignments are shell syntax that nohup
+        # (detached mode) cannot exec — `nohup env K=V cmd` works in both.
         inner = (
-            f"{export_str} {python} -u {shlex.quote(script)} {args_str}"
+            f"env {export_str} {python} -u {shlex.quote(script)} {args_str}"
         ).strip()
     if detach_job:
         job = shlex.quote(detach_job)
+        if image:
+            # Name the container so status/stop can address it via
+            # docker (the nohup pid is the root-owned `sudo docker run`,
+            # unsignalable by the ssh user).
+            inner = inner.replace(
+                "docker run --rm", f"docker run --rm --name ddl-job-{job}", 1
+            )
         return (
             f"cd {workdir} && mkdir -p logs && "
             f"nohup {inner} > logs/{job}.log 2>&1 & "
@@ -275,6 +284,32 @@ def build_remote_command(
             f"echo submitted {job} pid $(cat logs/{job}.pid)"
         )
     return f"cd {workdir} && {inner}"
+
+
+def ssh_command(
+    tpu: str,
+    zone: str,
+    command: str,
+    *,
+    worker: str = "all",
+    project: Optional[str] = None,
+) -> List[str]:
+    """The one place the ``gcloud … tpu-vm ssh`` argv is assembled
+    (launcher, submitter, and provisioner all route through here)."""
+    cmd = [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "ssh",
+        tpu,
+        f"--zone={zone}",
+        f"--worker={worker}",
+        f"--command={command}",
+    ]
+    if project:
+        cmd.insert(5, f"--project={project}")
+    return cmd
 
 
 def build_pod_command(
@@ -301,20 +336,7 @@ def build_pod_command(
         detach_job=detach_job,
         image=image,
     )
-    cmd = [
-        "gcloud",
-        "compute",
-        "tpus",
-        "tpu-vm",
-        "ssh",
-        tpu,
-        f"--zone={zone}",
-        f"--worker={worker}",
-        f"--command={remote}",
-    ]
-    if project:
-        cmd.insert(5, f"--project={project}")
-    return cmd
+    return ssh_command(tpu, zone, remote, worker=worker, project=project)
 
 
 def launch_pod(

@@ -26,24 +26,40 @@ from distributeddeeplearning_tpu.models.resnet import (
 from distributeddeeplearning_tpu.models.vit import ViT
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
+_ATTENTION_MODELS: set = set()
 
 
-def register_model(name: str, factory: Callable[..., Any]) -> None:
+def register_model(
+    name: str, factory: Callable[..., Any], *, attention: bool = False
+) -> None:
     _REGISTRY[name.lower()] = factory
+    if attention:
+        _ATTENTION_MODELS.add(name.lower())
 
 
-def get_model(name: str, *, num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+def get_model(
+    name: str,
+    *,
+    num_classes: int = 1000,
+    dtype=jnp.bfloat16,
+    attn_impl: str = None,
+    **kw,
+):
     """Instantiate a model by name (e.g. ``"resnet50"``).
 
     ``dtype`` may be a jnp dtype or a string (``TrainConfig.compute_dtype``,
     e.g. ``"bfloat16"``/``"float32"`` — the compute dtype of the forward
-    pass; params stay float32 either way).
+    pass; params stay float32 either way). ``attn_impl``
+    (``TrainConfig.attn_impl``: xla/pallas/ring) is forwarded to models
+    registered with attention support and ignored for conv models.
     """
     key = name.lower()
     if key not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
     if isinstance(dtype, str):
         dtype = jnp.dtype(dtype)
+    if attn_impl is not None and key in _ATTENTION_MODELS:
+        kw["attn_impl"] = attn_impl
     return _REGISTRY[key](num_classes=num_classes, dtype=dtype, **kw)
 
 
@@ -65,6 +81,7 @@ for _variant in ("ti", "s", "b", "l", "h"):
         (lambda v: (lambda num_classes=1000, dtype=jnp.bfloat16, **kw: ViT(
             variant=v, patch_size=16, num_classes=num_classes, dtype=dtype,
             **kw)))(_variant),
+        attention=True,
     )
 
 # EfficientNet family (BASELINE.json config: EfficientNet-B4).

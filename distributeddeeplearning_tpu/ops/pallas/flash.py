@@ -43,6 +43,14 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _vma(*arrays):
+    """Union of the inputs' varying-mesh-axes (empty outside shard_map)."""
+    out = set()
+    for a in arrays:
+        out |= set(getattr(jax.core.get_aval(a), "vma", ()) or ())
+    return frozenset(out)
+
+
 def _pick_block(pref: int, t: int) -> int:
     """Largest block ≤ ``pref`` that minimises trailing-block padding.
 
@@ -181,8 +189,13 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq_p, _LANES), jnp.float32),
+            # vma: inside shard_map (the DP/SP engines) outputs vary over
+            # the same mesh axes as the inputs; check_vma requires saying
+            # so explicitly.
+            jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype, vma=_vma(qp, kp, vp)),
+            jax.ShapeDtypeStruct(
+                (bh, tq_p, _LANES), jnp.float32, vma=_vma(qp, kp, vp)
+            ),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -252,6 +265,11 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
         return dq_acc, (dk_b, dv_b)
 
     dq0 = jnp.zeros((bh, tq, d), jnp.float32)
+    vma = tuple(sorted(_vma(q, k, v, do)))
+    if vma:
+        # Inside shard_map: the scan carry must match the varying-axes
+        # type of the per-step outputs it accumulates.
+        dq0 = lax.pcast(dq0, vma, to="varying")
     dq, (dk_blocks, dv_blocks) = lax.scan(
         body, dq0, (jnp.arange(nkb), k_blocks, v_blocks)
     )

@@ -37,6 +37,7 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Sequence
 
+from distributeddeeplearning_tpu.launch import ssh_command
 from distributeddeeplearning_tpu.utils.env import dotenv_for, load_env_file, set_key
 
 #: default TPU software version for v5e pods (override with --version)
@@ -146,14 +147,8 @@ def setup_commands(
     ``workdir`` mounted. Ends with a JAX device-count smoke — the
     reference's de-facto acceptance check (NCCL_DEBUG ring lines →
     here, global device count)."""
-    ssh_steps = [f"mkdir -p {workdir} {workdir}/logs"]
     cmds = [
-        _gcloud(
-            "compute", "tpus", "tpu-vm", "ssh", tpu,
-            f"--zone={zone}", "--worker=all",
-            f"--command={ssh_steps[0]}",
-            project=project,
-        ),
+        ssh_command(tpu, zone, f"mkdir -p {workdir} {workdir}/logs", project=project),
         # Code staging (reference cell 11's upload-scripts-to-share):
         _gcloud(
             "compute", "tpus", "tpu-vm", "scp", "--recurse",
@@ -184,13 +179,7 @@ def setup_commands(
             "'sees', jax.device_count(), 'global devices')\""
         )
     cmds.extend(
-        _gcloud(
-            "compute", "tpus", "tpu-vm", "ssh", tpu,
-            f"--zone={zone}", "--worker=all",
-            f"--command={step}",
-            project=project,
-        )
-        for step in ssh_steps
+        ssh_command(tpu, zone, step, project=project) for step in ssh_steps
     )
     return cmds
 
@@ -220,6 +209,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--env-file", default=None, help=".env with defaults")
     ap.add_argument("--project", default=None)
+    # parent-level like submit.py, so `provision --tpu X --zone Y <cmd>`
+    # and the Makefile's shared TPU_FLAGS work for both CLIs
+    ap.add_argument("--tpu", default=None)
+    ap.add_argument("--zone", default=None)
     ap.add_argument("--dry-run", action="store_true")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -235,8 +228,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ("setup", "bring up every worker (nodeprep equivalent)"),
     ):
         p = sub.add_parser(name, help=help_)
-        p.add_argument("--tpu", default=None)
-        p.add_argument("--zone", default=None)
         if name == "pod-create":
             p.add_argument("--accelerator-type", default="v5litepod-8")
             p.add_argument("--version", default=DEFAULT_RUNTIME)

@@ -34,7 +34,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
-from distributeddeeplearning_tpu.launch import build_pod_command
+from distributeddeeplearning_tpu.launch import build_pod_command, ssh_command
 from distributeddeeplearning_tpu.utils.env import (
     dotenv_for,
     load_env_file,
@@ -111,11 +111,7 @@ def stream_command(
 ) -> List[str]:
     """``az batchai job file stream stdout.txt`` parity (cells 25-26)."""
     tail = f"tail {'-f ' if follow else ''}-n +1 {workdir}/logs/{job}.log"
-    return [
-        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu,
-        *([f"--project={project}"] if project else []),
-        f"--zone={zone}", f"--worker={worker}", f"--command={tail}",
-    ]
+    return ssh_command(tpu, zone, tail, worker=worker, project=project)
 
 
 def control_command(
@@ -127,27 +123,36 @@ def control_command(
     project: Optional[str] = None,
     workdir: str = "~/ddl",
 ) -> List[str]:
-    """status (poll, reference cell 21) / stop (kill) for detached jobs."""
+    """status (poll, reference cell 21) / stop (kill) for detached jobs.
+
+    Handles both launch modes: host-python jobs via the recorded pid
+    (``sudo kill``: nohup'd processes may outlive the ssh session user),
+    containerized jobs (``submit --image``) via the ``ddl-job-<job>``
+    container name — the pid file there holds the root-owned
+    ``sudo docker run`` wrapper, which only docker can address.
+    """
+    ctr = f"ddl-job-{job}"
     if action == "status":
         remote = (
-            f"test -f {workdir}/logs/{job}.pid && "
-            f"(kill -0 $(cat {workdir}/logs/{job}.pid) 2>/dev/null "
-            f"&& echo {job}: running pid $(cat {workdir}/logs/{job}.pid) "
-            f"|| echo {job}: finished) || echo {job}: unknown"
+            f"if sudo docker ps -q -f name={ctr} 2>/dev/null | grep -q .; "
+            f"then echo {job}: running in container {ctr}; "
+            f"elif test -f {workdir}/logs/{job}.pid && "
+            f"sudo kill -0 $(cat {workdir}/logs/{job}.pid) 2>/dev/null; "
+            f"then echo {job}: running pid $(cat {workdir}/logs/{job}.pid); "
+            f"elif test -f {workdir}/logs/{job}.pid; "
+            f"then echo {job}: finished; "
+            f"else echo {job}: unknown; fi"
         )
     elif action == "stop":
         remote = (
+            f"sudo docker stop {ctr} 2>/dev/null; "
             f"test -f {workdir}/logs/{job}.pid && "
-            f"kill $(cat {workdir}/logs/{job}.pid) 2>/dev/null; "
+            f"sudo kill $(cat {workdir}/logs/{job}.pid) 2>/dev/null; "
             f"echo {job}: stopped"
         )
     else:
         raise ValueError(action)
-    return [
-        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu,
-        *([f"--project={project}"] if project else []),
-        f"--zone={zone}", "--worker=all", f"--command={remote}",
-    ]
+    return ssh_command(tpu, zone, remote, project=project)
 
 
 def _parse_env(pairs: Sequence[str]) -> Dict[str, str]:

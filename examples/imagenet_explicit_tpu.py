@@ -31,7 +31,10 @@ def main():
     logger.info("explicit-loop training: %s", config)
 
     model = get_model(
-        config.model, num_classes=config.num_classes, dtype=config.compute_dtype
+        config.model,
+        num_classes=config.num_classes,
+        dtype=config.compute_dtype,
+        attn_impl=config.attn_impl,
     )
     train_data = make_dataset(config, train=True)
     pieces, state = explicit.setup(

@@ -97,8 +97,8 @@ def main() -> None:
             length=32,
         )
         labels = []
-        for _, y in ds.epoch(0):
-            labels.extend(int(v) for v in y)
+        for _, y, w in ds.epoch(0):  # eval path yields (img, label, weight)
+            labels.extend(int(v) for v in np.asarray(y)[np.asarray(w) > 0])
         assert len(labels) == 16, len(labels)
         mine = np.sort(np.asarray(labels, np.int32))
         both = multihost_utils.process_allgather(mine)

@@ -59,7 +59,7 @@ def test_submit_inside_container_matches_setup_image():
         tpu="pod", zone="z", detach=True, image="gcr.io/p/ddl-tpu",
     )
     joined = " ".join(cmd)
-    assert "docker run --rm --privileged --net=host" in joined
+    assert "docker run --rm --name ddl-job-j2 --privileged --net=host" in joined
     assert "gcr.io/p/ddl-tpu" in joined
     assert "-e DISTRIBUTED=True" in joined
     assert "logs/j2.log" in joined  # detach still logs on the host side
@@ -68,7 +68,7 @@ def test_submit_inside_container_matches_setup_image():
 def test_provision_cli_dry_run(capsys, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)  # .env writes stay in tmp
     rc = provision.main(
-        ["--dry-run", "pod-create", "--tpu", "pod", "--zone", "z"]
+        ["--tpu", "pod", "--zone", "z", "--dry-run", "pod-create"]
     )
     assert rc == 0
     out = capsys.readouterr().out
@@ -102,7 +102,8 @@ def test_submit_foreground_and_detached():
         "j1", "train.py", (), tpu="pod", zone="z", detach=True,
     )
     joined = " ".join(det)
-    assert "nohup" in joined
+    # `nohup env K=V python` — nohup cannot exec a bare K=V assignment
+    assert "nohup env " in joined
     assert "logs/j1.log" in joined
     assert "logs/j1.pid" in joined
 
@@ -114,9 +115,13 @@ def test_stream_and_control_commands():
     s2 = submit.stream_command("j1", tpu="pod", zone="z", follow=False)
     assert not any("tail -f" in c for c in s2)
     st = submit.control_command("j1", "status", tpu="pod", zone="z")
-    assert any("kill -0" in c for c in st)
+    # must handle both host-pid jobs and containerized (--image) jobs
+    assert any("sudo kill -0" in c and "docker ps" in c for c in st)
     sp = submit.control_command("j1", "stop", tpu="pod", zone="z")
-    assert any("kill $(cat" in c for c in sp)
+    assert any(
+        "sudo docker stop ddl-job-j1" in c and "sudo kill $(cat" in c
+        for c in sp
+    )
     with pytest.raises(ValueError):
         submit.control_command("j1", "bogus", tpu="pod", zone="z")
 
