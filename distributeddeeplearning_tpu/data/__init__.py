@@ -1,4 +1,7 @@
-from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
+from distributeddeeplearning_tpu.data.synthetic import (
+    SyntheticImageDataset,
+    SyntheticTokenDataset,
+)
 from distributeddeeplearning_tpu.data.pipeline import shard_batch, prefetch_to_device
 
 
@@ -62,8 +65,10 @@ def make_input_fn(train: bool = True):
 
 __all__ = [
     "SyntheticImageDataset",
+    "SyntheticTokenDataset",
     "shard_batch",
     "prefetch_to_device",
     "make_dataset",
     "make_input_fn",
+    "staging_dtype",
 ]

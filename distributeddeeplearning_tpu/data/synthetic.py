@@ -24,6 +24,38 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 
+def _check_divisible(global_batch_size: int, process_count: int) -> None:
+    if global_batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{process_count} processes"
+        )
+
+
+def _virtual_translation(
+    seed: int, process_index: int, pool_n: int, local_len: int
+) -> Tuple[int, np.ndarray]:
+    """The virtual→physical translation-index contract shared by every
+    synthetic dataset (reference ``data_generator.py:45``): a per-process
+    seed offset so hosts draw disjoint streams, sized to the local share
+    of the virtual length."""
+    idx_seed = (seed + 1 + process_index) % (2**31 - 1)
+    translation = np.random.RandomState(idx_seed).randint(
+        0, pool_n, size=(max(local_len, 1),)
+    )
+    return idx_seed, translation
+
+
+def _epoch_permutation(
+    idx_seed: int, translation: np.ndarray, epoch_index: int
+) -> np.ndarray:
+    """Deterministic per-epoch reshuffle (Keras ``_set_index_array``
+    parity), identical across the dataset types."""
+    return np.random.RandomState(
+        (idx_seed + 7919 * epoch_index) % (2**31 - 1)
+    ).permutation(translation)
+
+
 class SyntheticImageDataset:
     """Seeded random images + labels with a virtual length.
 
@@ -49,11 +81,7 @@ class SyntheticImageDataset:
         exact: bool = False,
         dtype: np.dtype = np.float32,
     ):
-        if global_batch_size % process_count != 0:
-            raise ValueError(
-                f"global batch {global_batch_size} not divisible by "
-                f"{process_count} processes"
-            )
+        _check_divisible(global_batch_size, process_count)
         self.length = length
         self.global_batch_size = global_batch_size
         self.local_batch_size = global_batch_size // process_count
@@ -82,9 +110,9 @@ class SyntheticImageDataset:
         else:
             local_len = length // process_count
             self.steps_per_epoch = max(length // global_batch_size, 1)
-        self._idx_seed = (seed + 1 + process_index) % (2**31 - 1)
-        idx_rng = np.random.RandomState(self._idx_seed)
-        self._translation_index = idx_rng.randint(0, pool_n, size=(max(local_len, 1),))
+        self._idx_seed, self._translation_index = _virtual_translation(
+            seed, process_index, pool_n, local_len
+        )
         self._local_len = local_len
 
     def __len__(self) -> int:
@@ -99,8 +127,7 @@ class SyntheticImageDataset:
         translation index per epoch.
         """
         b = self.local_batch_size
-        perm_rng = np.random.RandomState((self._idx_seed + 7919 * epoch_index) % (2**31 - 1))
-        index = perm_rng.permutation(self._translation_index)
+        index = _epoch_permutation(self._idx_seed, self._translation_index, epoch_index)
         for step in range(self.steps_per_epoch):
             start = step * b
             slots = np.arange(start, start + b)
@@ -115,6 +142,62 @@ class SyntheticImageDataset:
                 yield images, labels, weights
             else:
                 yield images, labels
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+class SyntheticTokenDataset:
+    """Seeded random token stream for LM training — the ``FAKE=True``
+    contract (SURVEY.md §4.1), token edition.
+
+    Same virtual-length trick as :class:`SyntheticImageDataset`: a small
+    physical pool of ``[seq_len+1]`` token rows indexed through a
+    seeded translation index, yielding ``(tokens[:, :-1], tokens[:, 1:])``
+    next-token pairs, per-process sharded.
+    """
+
+    def __init__(
+        self,
+        *,
+        length: int = 100_000,
+        global_batch_size: int,
+        seq_len: int = 128,
+        vocab_size: int = 32_000,
+        num_physical_batches: int = 20,
+        seed: int = 42,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        _check_divisible(global_batch_size, process_count)
+        self.length = length
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // process_count
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.process_index = process_index
+        self.process_count = process_count
+
+        rng = np.random.RandomState(seed)
+        pool_n = num_physical_batches * self.local_batch_size
+        self._rows = rng.randint(
+            0, vocab_size, size=(pool_n, seq_len + 1)
+        ).astype(np.int32)
+        self._idx_seed, self._translation_index = _virtual_translation(
+            seed, process_index, pool_n, length // process_count
+        )
+        self.steps_per_epoch = max(length // global_batch_size, 1)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def epoch(self, epoch_index: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        b = self.local_batch_size
+        index = _epoch_permutation(self._idx_seed, self._translation_index, epoch_index)
+        for step in range(self.steps_per_epoch):
+            sel = index[np.arange(step * b, step * b + b) % len(index)]
+            rows = self._rows[sel]
+            yield rows[:, :-1], rows[:, 1:]
 
     def __iter__(self):
         return self.epoch(0)

@@ -58,13 +58,23 @@ def setup(
     *,
     mesh=None,
     steps_per_epoch: Optional[int] = None,
+    input_shape=None,
+    input_dtype=None,
 ) -> Tuple[Pieces, TrainState]:
     """Build mesh, optimizer, compiled steps, and the initial state —
-    the explicit analogue of reference ``main()`` setup (:267-338)."""
+    the explicit analogue of reference ``main()`` setup (:267-338).
+
+    ``input_shape``/``input_dtype`` override the image init contract for
+    non-image models (LM: ``(1, seq_len)``, ``jnp.int32``)."""
     mesh = mesh if mesh is not None else data_parallel_mesh()
     spe = steps_per_epoch or config.steps_per_epoch()
     tx, schedule = create_optimizer(config, spe)
-    state = replicate_state(create_train_state(model, config, tx), mesh)
+    state = replicate_state(
+        create_train_state(
+            model, config, tx, input_shape=input_shape, input_dtype=input_dtype
+        ),
+        mesh,
+    )
     pieces = Pieces(
         model=model,
         config=config,

@@ -23,6 +23,7 @@ from distributeddeeplearning_tpu.models.resnet import (
     ResNet200,
     resnet_v1,
 )
+from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
 from distributeddeeplearning_tpu.models.vit import ViT
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
@@ -40,15 +41,18 @@ def register_model(
 def get_model(
     name: str,
     *,
-    num_classes: int = 1000,
+    num_classes: int = None,
     dtype=jnp.bfloat16,
     attn_impl: str = None,
     **kw,
 ):
     """Instantiate a model by name (e.g. ``"resnet50"``).
 
-    ``dtype`` may be a jnp dtype or a string (``TrainConfig.compute_dtype``,
-    e.g. ``"bfloat16"``/``"float32"`` — the compute dtype of the forward
+    ``num_classes=None`` keeps each family's own default (1000 ImageNet
+    classes for the vision zoo, 32k vocab for the LMs — forcing one
+    global default would silently shrink an LM's vocab). ``dtype`` may
+    be a jnp dtype or a string (``TrainConfig.compute_dtype``, e.g.
+    ``"bfloat16"``/``"float32"`` — the compute dtype of the forward
     pass; params stay float32 either way). ``attn_impl``
     (``TrainConfig.attn_impl``: xla/pallas/ring) is forwarded to models
     registered with attention support and ignored for conv models.
@@ -60,7 +64,9 @@ def get_model(
         dtype = jnp.dtype(dtype)
     if attn_impl is not None and key in _ATTENTION_MODELS:
         kw["attn_impl"] = attn_impl
-    return _REGISTRY[key](num_classes=num_classes, dtype=dtype, **kw)
+    if num_classes is not None:
+        kw["num_classes"] = num_classes
+    return _REGISTRY[key](dtype=dtype, **kw)
 
 
 def available_models():
@@ -81,6 +87,15 @@ for _variant in ("ti", "s", "b", "l", "h"):
         (lambda v: (lambda num_classes=1000, dtype=jnp.bfloat16, **kw: ViT(
             variant=v, patch_size=16, num_classes=num_classes, dtype=dtype,
             **kw)))(_variant),
+        attention=True,
+    )
+
+# Decoder-only LM family (long-context tier; num_classes = vocab size).
+for _v in ("tiny", "small", "base", "large"):
+    register_model(
+        f"lm_{_v}",
+        (lambda v: (lambda num_classes=32_000, dtype=jnp.bfloat16, **kw: TransformerLM(
+            variant=v, vocab_size=num_classes, dtype=dtype, **kw)))(_v),
         attention=True,
     )
 

@@ -1,0 +1,126 @@
+"""Decoder-only Transformer LM — the long-context workload tier.
+
+Not in the reference (vision-only; SURVEY.md §5 notes it "scales only
+the batch axis"). This framework treats long sequences as first-class:
+the LM's causal attention routes through ``ops.dot_product_attention``,
+so the same module runs the XLA einsum path, the Pallas flash kernel
+(O(T·d) memory — the only way long contexts fit, see
+``ops/pallas/flash.py``), or — inside a ``seq``-axis ``shard_map`` —
+ring sequence parallelism (``parallel/ring_attention.py``).
+
+Design mirrors ``models/vit.py``: pre-norm blocks, bf16 compute / f32
+params, LayerNorm in f32, every weight annotated with logical axes
+(``LOGICAL_RULES`` there apply: heads/mlp → ``model`` for Megatron-style
+TP under the pjit engine).
+
+Input ``[B, T]`` int32 tokens → logits ``[B, T, vocab]`` f32; pair with
+shifted labels and the engine's generalized ``cross_entropy_loss``
+(per-token CE). ``data.SyntheticTokenDataset`` supplies the seeded
+synthetic stream (the ``FAKE=True`` contract, token edition).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models.vit import Attention, MlpBlock
+
+# name -> (hidden, depth, heads, mlp_dim)
+_VARIANTS = {
+    "tiny": (128, 2, 4, 512),
+    "small": (512, 8, 8, 2048),
+    "base": (768, 12, 12, 3072),
+    "large": (1536, 24, 16, 6144),
+}
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        x = x + Attention(
+            self.num_heads,
+            self.dtype,
+            self.attn_impl,
+            self.dropout,
+            causal=True,
+            name="attn",
+        )(y, train)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        x = x + MlpBlock(self.mlp_dim, self.dtype, self.dropout, name="mlp")(y, train)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over int32 token ids; returns f32 ``[B, T, vocab]``."""
+
+    variant: str = "tiny"
+    vocab_size: int = 32_000
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        if self.variant not in _VARIANTS:
+            raise ValueError(f"variant must be one of {sorted(_VARIANTS)}")
+        hidden, depth, heads, mlp_dim = _VARIANTS[self.variant]
+        b, t = tokens.shape
+        if t > self.max_seq_len:
+            raise ValueError(f"sequence {t} exceeds max_seq_len {self.max_seq_len}")
+
+        embed = self.param(
+            "tok_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (self.vocab_size, hidden),
+            jnp.float32,
+        )
+        x = embed[tokens].astype(self.dtype)
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "seq", "embed")
+            ),
+            (1, self.max_seq_len, hidden),
+            jnp.float32,
+        )
+        x = x + pos[:, :t].astype(self.dtype)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        for i in range(depth):
+            x = DecoderBlock(
+                heads,
+                mlp_dim,
+                self.dtype,
+                self.attn_impl,
+                self.dropout,
+                name=f"block{i}",
+            )(x, train)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # Tied output projection (standard LM practice; halves embedding
+        # params vs an untied head).
+        logits = jnp.einsum(
+            "btd,vd->btv", x.astype(jnp.float32), embed.astype(jnp.float32)
+        )
+        return logits
+
+
+LM_Tiny = functools.partial(TransformerLM, variant="tiny")
+LM_Small = functools.partial(TransformerLM, variant="small")
+LM_Base = functools.partial(TransformerLM, variant="base")
+LM_Large = functools.partial(TransformerLM, variant="large")

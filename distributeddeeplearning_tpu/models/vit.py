@@ -97,6 +97,7 @@ class Attention(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     dropout: float = 0.0
+    causal: bool = False  # decoder-only use (models/transformer_lm.py)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -105,7 +106,9 @@ class Attention(nn.Module):
         qkv = _dense(3 * d, "qkv", ("embed", "heads"), self.dtype)(x)
         qkv = qkv.reshape(*x.shape[:-1], 3, self.num_heads, head_dim)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        out = dot_product_attention(q, k, v, impl=self.attn_impl)
+        out = dot_product_attention(
+            q, k, v, causal=self.causal, impl=self.attn_impl
+        )
         out = out.reshape(*x.shape[:-1], d)
         out = _dense(d, "proj", ("heads", "embed"), self.dtype)(out)
         if self.dropout > 0:

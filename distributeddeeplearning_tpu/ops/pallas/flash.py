@@ -47,7 +47,7 @@ def _vma(*arrays):
     """Union of the inputs' varying-mesh-axes (empty outside shard_map)."""
     out = set()
     for a in arrays:
-        out |= set(getattr(jax.core.get_aval(a), "vma", ()) or ())
+        out |= set(getattr(jax.typeof(a), "vma", ()) or ())
     return frozenset(out)
 
 

@@ -134,7 +134,9 @@ def control_command(
     ctr = f"ddl-job-{job}"
     if action == "status":
         remote = (
-            f"if sudo docker ps -q -f name={ctr} 2>/dev/null | grep -q .; "
+            # anchored: -f name= is a substring/regex match, and job "j1"
+            # must not match container ddl-job-j10
+            f"if sudo docker ps -q -f name='^{ctr}$' 2>/dev/null | grep -q .; "
             f"then echo {job}: running in container {ctr}; "
             f"elif test -f {workdir}/logs/{job}.pid && "
             f"sudo kill -0 $(cat {workdir}/logs/{job}.pid) 2>/dev/null; "

@@ -50,7 +50,11 @@ Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (images NHWC, int labels)
 def cross_entropy_loss(
     logits: jnp.ndarray, labels: jnp.ndarray, label_smoothing: float = 0.0
 ) -> jnp.ndarray:
-    """Mean sparse softmax cross-entropy (reference TF ``:197-200``)."""
+    """Mean sparse softmax cross-entropy (reference TF ``:197-200``).
+
+    ``logits`` may carry any leading dims (``[B, C]`` classification,
+    ``[B, T, C]`` token prediction); ``labels`` matches the leading dims.
+    """
     num_classes = logits.shape[-1]
     if label_smoothing > 0.0:
         on = 1.0 - label_smoothing
@@ -59,7 +63,7 @@ def cross_entropy_loss(
         log_probs = jax.nn.log_softmax(logits)
         return -jnp.mean(jnp.sum(targets * log_probs, axis=-1))
     logp = jax.nn.log_softmax(logits)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
 
 
 def l2_kernel_penalty(params: PyTree, weight_decay: float) -> jnp.ndarray:
@@ -82,14 +86,22 @@ def create_train_state(
     tx,
     rng: Optional[jax.Array] = None,
     input_shape: Optional[Tuple[int, ...]] = None,
+    input_dtype=None,
 ) -> TrainState:
     """Deterministic seeded init — every process computes identical params,
     which *is* the broadcast (SURVEY.md §7: preferred over the reference's
-    ``BroadcastGlobalVariablesHook``)."""
+    ``BroadcastGlobalVariablesHook``).
+
+    ``input_shape``/``input_dtype`` default to the image contract
+    (``None`` → float32 images); token models init with
+    ``((1, seq_len), jnp.int32)``.
+    """
     rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
     shape = input_shape or (1, config.image_size, config.image_size, 3)
     variables = jax.jit(model.init, static_argnames=("train",))(
-        rng, jnp.zeros(shape, jnp.float32), train=False
+        rng,
+        jnp.zeros(shape, input_dtype if input_dtype is not None else jnp.float32),
+        train=False,
     )
     # Unbox nn.with_logical_partitioning metadata: boxed leaves would hide
     # the `kernel` path component from l2_kernel_penalty. Both engines
@@ -214,7 +226,15 @@ def eval_metrics_fn(
     every sample counts exactly once, unlike the reference's
     floor+modulo-wrap eval (and its ``validate()`` which simply drops the
     tail).
+
+    Token models (``[B, T, V]`` logits): flattened to per-token metrics,
+    with each sample's weight applied to all its tokens.
     """
+    if logits.ndim == 3:
+        b, t, v = logits.shape
+        logits = logits.reshape(b * t, v)
+        labels = labels.reshape(b * t)
+        weights = jnp.repeat(weights, t)
     w = weights.astype(jnp.float32)
     per_ex = -jnp.take_along_axis(
         jax.nn.log_softmax(logits), labels[:, None], axis=-1
