@@ -56,7 +56,13 @@ class Model:
         same information, one construction point here)."""
         if optimizer not in ("sgd", "momentum"):
             raise ValueError(f"unsupported optimizer {optimizer!r} (have sgd)")
-        if loss != "sparse_categorical_crossentropy":
+        if loss not in (
+            "sparse_categorical_crossentropy",
+            # one-hot labels — the reference Keras compile() choice
+            # (imagenet_keras_horovod.py:307); the engine's loss accepts
+            # both label shapes.
+            "categorical_crossentropy",
+        ):
             raise ValueError(f"unsupported loss {loss!r}")
         self._compiled = True
         return self

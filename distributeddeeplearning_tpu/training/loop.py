@@ -56,6 +56,20 @@ class FitResult:
     images_per_sec: float
 
 
+def _init_spec(data):
+    """Infer the model-init input signature from the dataset so every
+    front-end can train token models: a dataset exposing ``seq_len``
+    (SyntheticTokenDataset) inits with ``(1, seq_len)`` int32 tokens;
+    otherwise the image contract applies (``create_train_state``
+    defaults)."""
+    import jax.numpy as jnp
+
+    seq_len = getattr(data, "seq_len", None)
+    if seq_len is not None:
+        return (1, int(seq_len)), jnp.int32
+    return None, None
+
+
 def fit(
     model,
     config: TrainConfig,
@@ -87,7 +101,10 @@ def fit(
     if tx is None:
         tx, _ = create_optimizer(config, steps_per_epoch)
     if state is None:
-        state = create_train_state(model, config, tx)
+        shape, dtype = _init_spec(train_data)
+        state = create_train_state(
+            model, config, tx, input_shape=shape, input_dtype=dtype
+        )
     state = replicate_state(state, mesh)
 
     from distributeddeeplearning_tpu.training.callbacks import (

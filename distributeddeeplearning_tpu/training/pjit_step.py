@@ -186,7 +186,8 @@ def make_pjit_train_step(
         )(state.params)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
-        accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == hard).astype(jnp.float32))
         metrics = {
             "loss": loss,
             "accuracy": accuracy,

@@ -54,8 +54,20 @@ def cross_entropy_loss(
 
     ``logits`` may carry any leading dims (``[B, C]`` classification,
     ``[B, T, C]`` token prediction); ``labels`` matches the leading dims.
+    One-hot (float, rank-of-logits) labels are accepted too — the
+    reference Keras path's ``categorical_crossentropy`` with its one-hot
+    ``FakeDataGenerator`` (``imagenet_keras_horovod.py:307``,
+    ``data_generator.py:48-53``).
     """
     num_classes = logits.shape[-1]
+    if labels.ndim == logits.ndim:  # one-hot
+        targets = labels.astype(jnp.float32)
+        if label_smoothing > 0.0:
+            on = 1.0 - label_smoothing
+            off = label_smoothing / (num_classes - 1)
+            targets = targets * (on - off) + off
+        log_probs = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.sum(targets * log_probs, axis=-1))
     if label_smoothing > 0.0:
         on = 1.0 - label_smoothing
         off = label_smoothing / (num_classes - 1)
@@ -116,13 +128,26 @@ def create_train_state(
     )
 
 
+def _pallas_interpreted(model) -> bool:
+    """True when this model's attention would run the Pallas kernel in
+    interpreter mode (non-TPU backend): the HLO interpreter's internal
+    slicing trips shard_map's varying-axes checker (upstream limitation;
+    its own error message recommends check_vma=False), so the engines
+    drop the check for exactly this case. The compiled TPU path keeps
+    checking on — verified on hardware."""
+    return (
+        getattr(model, "attn_impl", None) == "pallas"
+        and jax.default_backend() != "tpu"
+    )
+
+
 def make_train_step(
     model,
     tx,
     mesh: Mesh,
     config: Optional[TrainConfig] = None,
     donate_state: bool = True,
-    check_vma: bool = True,
+    check_vma: Optional[bool] = None,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the compiled DP train step over ``mesh``.
 
@@ -130,13 +155,12 @@ def make_train_step(
     ``state`` is replicated and the batch is sharded on its leading axis
     over the mesh's batch axes. Metrics are already cross-replica means.
 
-    ``check_vma=False`` is needed only when a Pallas kernel runs in
-    *interpreter* mode inside this step (CPU test mesh): the HLO
-    interpreter's internal slicing trips the varying-axes checker
-    (upstream limitation; its own error message recommends this flag).
-    The compiled TPU path keeps checking on — verified on hardware.
+    ``check_vma=None`` auto-resolves: on except for interpreter-mode
+    Pallas attention (see :func:`_pallas_interpreted`).
     """
     cfg = config or TrainConfig()
+    if check_vma is None:
+        check_vma = not _pallas_interpreted(model)
     axes = batch_axes(mesh)
     if not axes:
         raise ValueError(f"mesh {mesh.axis_names} has no batch axis")
@@ -192,7 +216,8 @@ def make_train_step(
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
 
-        accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == hard).astype(jnp.float32))
         metrics = lax.pmean(
             {"loss": loss, "accuracy": accuracy, "grad_norm": optax.global_norm(grads)},
             axis,
@@ -228,17 +253,23 @@ def eval_metrics_fn(
     tail).
 
     Token models (``[B, T, V]`` logits): flattened to per-token metrics,
-    with each sample's weight applied to all its tokens.
+    with each sample's weight applied to all its tokens. One-hot labels
+    (the categorical_crossentropy mode) are reduced to hard labels for
+    top-k and used directly for the CE term.
     """
+    one_hot = labels.ndim == logits.ndim
     if logits.ndim == 3:
         b, t, v = logits.shape
         logits = logits.reshape(b * t, v)
-        labels = labels.reshape(b * t)
+        labels = labels.reshape((b * t, v) if one_hot else (b * t,))
         weights = jnp.repeat(weights, t)
     w = weights.astype(jnp.float32)
-    per_ex = -jnp.take_along_axis(
-        jax.nn.log_softmax(logits), labels[:, None], axis=-1
-    )[:, 0]
+    logp = jax.nn.log_softmax(logits)
+    if one_hot:
+        per_ex = -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
+        labels = jnp.argmax(labels, axis=-1)
+    else:
+        per_ex = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     top1 = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
     top5 = jnp.any(
         jnp.argsort(logits, axis=-1)[:, -5:] == labels[:, None], axis=-1
@@ -252,7 +283,7 @@ def eval_metrics_fn(
 
 
 def make_eval_step(
-    model, mesh: Mesh
+    model, mesh: Mesh, check_vma: Optional[bool] = None
 ) -> Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]:
     """Compiled eval step: running-stats BN, cross-replica-summed weighted
     metrics (reference eval: TF ``:203-213``, Keras ``hvd.allreduce(score)``
@@ -267,6 +298,8 @@ def make_eval_step(
     if not axes:
         raise ValueError(f"mesh {mesh.axis_names} has no batch axis")
     axis = axes if len(axes) > 1 else axes[0]
+    if check_vma is None:
+        check_vma = not _pallas_interpreted(model)
 
     def local_eval(state: TrainState, batch):
         images, labels, weights = batch
@@ -289,6 +322,7 @@ def make_eval_step(
             mesh=mesh,
             in_specs=(P(), (batch_spec, batch_spec, batch_spec)),
             out_specs=P(),
+            check_vma=check_vma,
         )
     )
 

@@ -151,3 +151,47 @@ def test_compute_dtype_reaches_model():
     assert m32.module.dtype == jnp.float32
     m16 = Model("resnet18", CFG.replace(compute_dtype="bfloat16"))
     assert m16.module.dtype == jnp.bfloat16
+
+
+def test_keras_categorical_crossentropy_one_hot(mesh8):
+    """Reference Keras mode: categorical CE over the one-hot
+    FakeDataGenerator (imagenet_keras_horovod.py:307, data_generator.py
+    :48-53)."""
+    cfg = CFG.replace(validation=False)
+    data = SyntheticImageDataset(
+        length=32,
+        global_batch_size=cfg.global_batch_size,
+        image_size=cfg.image_size,
+        num_classes=cfg.num_classes,
+        num_physical_batches=2,
+        one_hot=True,
+    )
+    m = Model(_model(), cfg)
+    m.compile(loss="categorical_crossentropy")
+    result = m.fit(data, epochs=1)
+    assert np.isfinite(result.history[-1]["loss"])
+    assert 0.0 <= result.history[-1]["accuracy"] <= 1.0
+
+
+def test_one_hot_evaluation(mesh8):
+    """categorical mode evaluates too: eval_metrics_fn reduces one-hot
+    labels to hard labels for top-k and uses them for the CE term."""
+    cfg = CFG.replace(validation=False)
+    train = _data(cfg, length=32)
+    val = SyntheticImageDataset(
+        length=24,  # non-divisible: exercises pad+mask with one-hot
+        global_batch_size=cfg.global_batch_size,
+        image_size=cfg.image_size,
+        num_classes=cfg.num_classes,
+        num_physical_batches=2,
+        one_hot=True,
+        exact=True,
+    )
+    m = Model(_model(), cfg)
+    m.compile(loss="categorical_crossentropy")
+    m.fit(train, epochs=1)
+    metrics = m.evaluate(val)
+    assert metrics["samples"] == 24.0
+    for k in ("loss", "top1", "top5"):
+        assert np.isfinite(metrics[k])
+    assert metrics["top5"] >= metrics["top1"]

@@ -176,3 +176,21 @@ def test_dockerfile_mentions_tpu_stack():
     text = open(os.path.join(repo, "Dockerfile")).read()
     assert "jax[tpu]" in text
     assert "launch.py" in text  # smoke CMD = the 2-process run
+
+
+def test_notebook_front_end_is_valid_and_covers_lifecycle():
+    import json, os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "notebooks", "01_ProvisionAndTrain.ipynb")
+    nb = json.load(open(path))
+    assert nb["nbformat"] == 4
+    src = "".join(
+        "".join(c["source"]) for c in nb["cells"] if c["cell_type"] == "code"
+    )
+    for needle in (
+        "orchestration.provision", "orchestration.submit",
+        "pod-create", "setup", "run --detach", "stream", "pod-delete",
+        "data.prepare",
+    ):
+        assert needle in src, needle

@@ -131,3 +131,26 @@ def test_token_dataset_contract():
     )
     x0, _ = next(iter(d0.epoch(0)))
     assert x0.shape == (8, T)
+
+
+def test_lm_trains_through_keras_frontend(mesh8):
+    """Front-end reachability: Model('lm_tiny').fit(token_data) — the
+    engine infers the (1, seq_len) int32 init signature from the dataset."""
+    from distributeddeeplearning_tpu.frontends import Model
+
+    cfg = TrainConfig(
+        model="lm_tiny",
+        num_classes=VOCAB,
+        batch_size_per_device=2,
+        weight_decay=0.0,
+        compute_dtype="float32",
+    )
+    data = SyntheticTokenDataset(
+        length=32, global_batch_size=16, seq_len=T, vocab_size=VOCAB,
+        num_physical_batches=2,
+    )
+    m = Model(_model(), cfg)
+    m.compile()
+    result = m.fit(data, epochs=1)
+    assert np.isfinite(result.history[-1]["loss"])
+    assert int(m.state.step) == 2  # 32/(2*8)
