@@ -44,6 +44,7 @@ class DecoderBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     dropout: float = 0.0
+    seq_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -54,6 +55,7 @@ class DecoderBlock(nn.Module):
             self.attn_impl,
             self.dropout,
             causal=True,
+            seq_axis=self.seq_axis,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -62,7 +64,13 @@ class DecoderBlock(nn.Module):
 
 
 class TransformerLM(nn.Module):
-    """Causal LM over int32 token ids; returns f32 ``[B, T, vocab]``."""
+    """Causal LM over int32 token ids; returns f32 ``[B, T, vocab]``.
+
+    ``seq_axis``: set to the mesh's sequence axis name (``"seq"``) when
+    the model runs *inside* a sequence-parallel ``shard_map``
+    (``training/sp_step.py``): positions are then offset by this shard's
+    global start, and ``attn_impl="ring"`` attends across shards.
+    """
 
     variant: str = "tiny"
     vocab_size: int = 32_000
@@ -70,6 +78,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     dropout: float = 0.0
+    seq_axis: Any = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -97,7 +106,18 @@ class TransformerLM(nn.Module):
             (1, self.max_seq_len, hidden),
             jnp.float32,
         )
-        x = x + pos[:, :t].astype(self.dtype)
+        if self.seq_axis is not None and not self.is_initializing():
+            # Sequence-parallel: this shard holds global tokens
+            # [axis_index*t, (axis_index+1)*t). (Init traces outside
+            # shard_map where the axis is unbound; shapes don't depend
+            # on the slice, so init uses the prefix.)
+            from jax import lax
+
+            start = lax.axis_index(self.seq_axis) * t
+            pos_t = lax.dynamic_slice_in_dim(pos[0], start, t, axis=0)[None]
+        else:
+            pos_t = pos[:, :t]
+        x = x + pos_t.astype(self.dtype)
         if self.dropout > 0:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
@@ -108,6 +128,7 @@ class TransformerLM(nn.Module):
                 self.dtype,
                 self.attn_impl,
                 self.dropout,
+                seq_axis=self.seq_axis,
                 name=f"block{i}",
             )(x, train)
 

@@ -98,6 +98,7 @@ class Attention(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0
     causal: bool = False  # decoder-only use (models/transformer_lm.py)
+    seq_axis: Any = None  # mesh axis for impl='ring' (default "seq")
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -106,8 +107,18 @@ class Attention(nn.Module):
         qkv = _dense(3 * d, "qkv", ("embed", "heads"), self.dtype)(x)
         qkv = qkv.reshape(*x.shape[:-1], 3, self.num_heads, head_dim)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        # Params don't depend on the impl, and ring needs a bound mesh
+        # axis — init (traced outside shard_map) uses the xla path.
+        impl = self.attn_impl
+        if impl == "ring" and self.is_initializing():
+            impl = "xla"
         out = dot_product_attention(
-            q, k, v, causal=self.causal, impl=self.attn_impl
+            q,
+            k,
+            v,
+            causal=self.causal,
+            impl=impl,
+            axis_name=self.seq_axis,
         )
         out = out.reshape(*x.shape[:-1], d)
         out = _dense(d, "proj", ("heads", "embed"), self.dtype)(out)

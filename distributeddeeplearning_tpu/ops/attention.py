@@ -55,7 +55,8 @@ def dot_product_attention(
     """Multi-head attention over BTHD tensors.
 
     ``impl='ring'`` requires running inside ``shard_map`` with the
-    sequence dimension sharded over ``axis_name``.
+    sequence dimension sharded over ``axis_name`` (default: the mesh
+    convention's ``"seq"`` axis, ``parallel/mesh.py``).
     """
     if impl == "xla":
         return _xla_attention(q, k, v, causal=causal, scale=scale)
@@ -64,8 +65,7 @@ def dot_product_attention(
 
         return flash_attention(q, k, v, causal=causal, scale=scale)
     if impl == "ring":
-        if axis_name is None:
-            raise ValueError("impl='ring' requires axis_name of the seq mesh axis")
+        axis_name = axis_name or "seq"
         from distributeddeeplearning_tpu.parallel.ring_attention import (
             ring_attention,
         )

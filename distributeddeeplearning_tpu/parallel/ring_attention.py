@@ -109,12 +109,16 @@ def ring_attention(
 
     # Mark the zero-init carries device-varying: they depend on nothing
     # sharded yet, but the scan writes device-varying values into them.
+    # The carries must match the FULL varying-axes set of the inputs —
+    # under DP x SP the shards vary over (data, seq), not just the ring
+    # axis (sp_step.py).
+    vma = tuple(sorted(getattr(jax.typeof(q), "vma", ()) or (axis_name,)))
     m0 = lax.pcast(
-        jnp.full((b, h, t_local), _NEG_INF, jnp.float32), axis_name, to="varying"
+        jnp.full((b, h, t_local), _NEG_INF, jnp.float32), vma, to="varying"
     )
-    l0 = lax.pcast(jnp.zeros((b, h, t_local), jnp.float32), axis_name, to="varying")
+    l0 = lax.pcast(jnp.zeros((b, h, t_local), jnp.float32), vma, to="varying")
     acc0 = lax.pcast(
-        jnp.zeros((b, h, t_local, d), jnp.float32), axis_name, to="varying"
+        jnp.zeros((b, h, t_local, d), jnp.float32), vma, to="varying"
     )
     (m, l, acc, _, _), _ = lax.scan(
         body, (m0, l0, acc0, k, v), jnp.arange(n)

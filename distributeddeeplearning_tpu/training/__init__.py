@@ -9,6 +9,7 @@ from distributeddeeplearning_tpu.training.train_step import (
 from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
 from distributeddeeplearning_tpu.training import callbacks
 from distributeddeeplearning_tpu.training.loop import fit, evaluate, FitResult
+from distributeddeeplearning_tpu.training.sp_step import make_sp_train_step
 from distributeddeeplearning_tpu.training.pjit_step import (
     create_sharded_train_state,
     make_pjit_train_step,
@@ -28,6 +29,7 @@ __all__ = [
     "evaluate",
     "FitResult",
     "create_sharded_train_state",
+    "make_sp_train_step",
     "make_pjit_train_step",
     "make_pjit_eval_step",
 ]

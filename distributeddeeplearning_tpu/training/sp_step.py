@@ -1,0 +1,120 @@
+"""Sequence-parallel LM training — DP × SP over a ``(data, seq)`` mesh.
+
+The long-context training path the reference never had (SURVEY.md §5:
+it "scales only the batch axis"). Tokens ``[B, T]`` are sharded over
+BOTH mesh axes — batch over ``data``, sequence over ``seq`` — so a
+context window ``n_seq`` times longer than one device's memory fits:
+
+* every non-attention op (embeds, LayerNorm, MLP, logits, loss) is
+  per-token and runs on the local ``[B/n_d, T/n_s]`` shard untouched;
+* attention crosses shards via **ring attention**
+  (``parallel/ring_attention.py``): K/V shards rotate over the ``seq``
+  axis on ICI ``ppermute`` while the online-softmax state stays local —
+  the model is simply built with ``attn_impl="ring"``,
+  ``seq_axis="seq"``;
+* positions are globalised inside the model
+  (``TransformerLM.seq_axis``), and the causal mask uses global token
+  coordinates reconstructed from ``lax.axis_index``;
+* gradients are ``pmean``-reduced over *both* axes — with equal shard
+  sizes the mean over (data, seq) equals the global gradient of the
+  mean per-token loss, so the update matches single-device training
+  (asserted in ``tests/test_sp_step.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import optax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.training.state import TrainState
+from distributeddeeplearning_tpu.training.train_step import (
+    cross_entropy_loss,
+    flat_axis_index,
+    l2_kernel_penalty,
+)
+
+Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (tokens [B,T], labels [B,T])
+
+
+def make_sp_train_step(
+    model,
+    tx,
+    mesh: Mesh,
+    config: Optional[TrainConfig] = None,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    donate_state: bool = True,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Compiled DP×SP train step; ``model`` must be built with
+    ``attn_impl="ring"`` and ``seq_axis=seq_axis``."""
+    cfg = config or TrainConfig()
+    if getattr(model, "seq_axis", None) != seq_axis:
+        raise ValueError(
+            f"model.seq_axis={getattr(model, 'seq_axis', None)!r} must equal "
+            f"the step's seq_axis={seq_axis!r} (build the model with "
+            "seq_axis=... and attn_impl='ring')"
+        )
+    axes = (data_axis, seq_axis)
+    base_rng = jax.random.PRNGKey(cfg.seed)
+
+    def local_step(state: TrainState, batch: Batch):
+        tokens, labels = batch
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step), flat_axis_index(mesh, axes)
+        )
+        params_v = jax.tree.map(
+            lambda p: lax.pcast(p, axes, to="varying"), state.params
+        )
+
+        def loss_fn(params):
+            logits = model.apply(
+                {"params": params},
+                tokens,
+                train=True,
+                rngs={"dropout": dropout_rng},
+            )
+            # Local mean over the shard's tokens; pmean over equal-sized
+            # shards below makes it the exact global per-token mean.
+            loss = cross_entropy_loss(logits, labels, cfg.label_smoothing)
+            # Same objective as the DP/pjit engines (train_step.py:205).
+            loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_v)
+        grads = lax.pmean(grads, axes)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        metrics = lax.pmean(
+            {
+                "loss": loss,
+                "accuracy": accuracy,
+                "grad_norm": optax.global_norm(grads),
+            },
+            axes,
+        )
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt_state
+            ),
+            metrics,
+        )
+
+    spec = P(data_axis, seq_axis)
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), (spec, spec)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())

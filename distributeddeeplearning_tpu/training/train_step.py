@@ -128,6 +128,15 @@ def create_train_state(
     )
 
 
+def flat_axis_index(mesh: Mesh, axes) -> jnp.ndarray:
+    """Row-major flat index of this shard across ``axes`` (shared by the
+    DP and SP engines for per-device rng derivation)."""
+    idx = jnp.zeros((), jnp.int32)
+    for a in axes:
+        idx = idx * mesh.shape[a] + lax.axis_index(a)
+    return idx
+
+
 def _pallas_interpreted(model) -> bool:
     """True when this model's attention would run the Pallas kernel in
     interpreter mode (non-TPU backend): the HLO interpreter's internal
@@ -168,11 +177,7 @@ def make_train_step(
     base_rng = jax.random.PRNGKey(cfg.seed)
 
     def _device_index():
-        # Flat index of this shard across the batch axes (row-major).
-        idx = jnp.zeros((), jnp.int32)
-        for a in axes:
-            idx = idx * mesh.shape[a] + lax.axis_index(a)
-        return idx
+        return flat_axis_index(mesh, axes)
 
     def local_step(state: TrainState, batch: Batch):
         images, labels = batch
