@@ -50,6 +50,10 @@ LOGICAL_RULES = (
     ("head_dim", None),
     ("mlp", "model"),
     ("classes", None),
+    # LM tied embedding (models/transformer_lm.py): replicated — its
+    # matmuls contract over "embed"; shard over "model" only at vocab
+    # sizes where the table dominates memory.
+    ("vocab", None),
 )
 
 DATA_PARALLEL_RULES = tuple(

@@ -69,6 +69,7 @@ def logical_shardings(
     rules: Sequence[Tuple[str, Any]],
     input_shape: Tuple[int, ...],
     rng: Optional[jax.Array] = None,
+    input_dtype=jnp.float32,
 ) -> Tuple[PyTree, PyTree]:
     """(abstract_variables, NamedSharding tree for ``params``).
 
@@ -79,7 +80,7 @@ def logical_shardings(
     abstract = jax.eval_shape(
         functools.partial(model.init, train=False),
         rng,
-        jnp.zeros(input_shape, jnp.float32),
+        jnp.zeros(input_shape, input_dtype),
     )
     logical_spec = nn.get_partition_spec(abstract)
     shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
@@ -95,14 +96,18 @@ def create_sharded_train_state(
     *,
     input_shape: Optional[Tuple[int, ...]] = None,
     rng: Optional[jax.Array] = None,
+    input_dtype=jnp.float32,
 ) -> TrainState:
-    """Seeded init, sharded at birth (no replicated intermediate)."""
+    """Seeded init, sharded at birth (no replicated intermediate).
+    ``input_shape``/``input_dtype``: token models pass ((1, T), int32)."""
     rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
     shape = input_shape or (1, config.image_size, config.image_size, 3)
-    _, param_shardings = logical_shardings(model, mesh, rules, shape, rng)
+    _, param_shardings = logical_shardings(
+        model, mesh, rules, shape, rng, input_dtype=input_dtype
+    )
 
     def init_fn(r):
-        variables = model.init(r, jnp.zeros(shape, jnp.float32), train=False)
+        variables = model.init(r, jnp.zeros(shape, input_dtype), train=False)
         params = lax.with_sharding_constraint(
             nn.unbox(variables["params"]), param_shardings
         )

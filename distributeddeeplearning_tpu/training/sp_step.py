@@ -62,11 +62,32 @@ def make_sp_train_step(
             f"the step's seq_axis={seq_axis!r} (build the model with "
             "seq_axis=... and attn_impl='ring')"
         )
+    if getattr(model, "attn_impl", None) != "ring":
+        # Any other impl attends only within each shard's local tokens —
+        # block-diagonal attention that trains without error but is wrong.
+        raise ValueError(
+            f"model.attn_impl={getattr(model, 'attn_impl', None)!r}: "
+            "sequence-parallel training requires attn_impl='ring'"
+        )
     axes = (data_axis, seq_axis)
     base_rng = jax.random.PRNGKey(cfg.seed)
 
+    # NOTE: mirrors train_step.make_train_step's local_step minus the
+    # paths SP deliberately doesn't carry (BatchNorm mutation, one-hot
+    # labels); keep loss/rng/metrics semantics in sync with it.
     def local_step(state: TrainState, batch: Batch):
         tokens, labels = batch
+        # Shapes are static at trace time: catch a global sequence longer
+        # than the position table here — dynamic_slice would silently
+        # clamp shard starts otherwise.
+        global_t = tokens.shape[1] * mesh.shape[seq_axis]
+        max_len = getattr(model, "max_seq_len", None)
+        if max_len is not None and global_t > max_len:
+            raise ValueError(
+                f"global sequence {global_t} (local {tokens.shape[1]} x "
+                f"{mesh.shape[seq_axis]} shards) exceeds model.max_seq_len "
+                f"{max_len}"
+            )
         dropout_rng = jax.random.fold_in(
             jax.random.fold_in(base_rng, state.step), flat_axis_index(mesh, axes)
         )

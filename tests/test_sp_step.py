@@ -116,3 +116,33 @@ def test_sp_step_rejects_mismatched_model(sp_mesh):
     tx = optax.sgd(0.1)
     with pytest.raises(ValueError, match="seq_axis"):
         make_sp_train_step(_model(), tx, sp_mesh, CFG)
+
+
+def test_sp_step_rejects_non_ring_impl(sp_mesh):
+    tx = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="ring"):
+        make_sp_train_step(_model(seq_axis="seq", impl="xla"), tx, sp_mesh, CFG)
+
+
+def test_sp_step_rejects_overlong_global_sequence(sp_mesh):
+    """max_seq_len guards the GLOBAL sequence: local shards would pass the
+    model's own check while dynamic_slice silently clamps positions."""
+    tx = optax.sgd(0.1)
+    model = TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=T // 2,  # global T too long
+        dtype=jnp.float32, attn_impl="ring", seq_axis="seq",
+    )
+    state = replicate_state(
+        create_train_state(
+            model, CFG, tx, input_shape=(1, T // 2), input_dtype=jnp.int32
+        ),
+        sp_mesh,
+    )
+    step = make_sp_train_step(model, tx, sp_mesh, CFG, donate_state=False)
+    spec = NamedSharding(sp_mesh, P("data", "seq"))
+    tokens, labels = _batch()
+    with pytest.raises(ValueError, match="exceeds model.max_seq_len"):
+        step(
+            state,
+            (jax.device_put(tokens, spec), jax.device_put(labels, spec)),
+        )
