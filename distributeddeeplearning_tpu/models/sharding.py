@@ -1,0 +1,30 @@
+"""Model-neutral logical-axis → mesh-axis rules for the pjit engine.
+
+One table shared by every annotated model (ViT's attention/MLP axes, the
+LM's tied vocab embedding): ``training/pjit_step.py`` passes these to
+``nn.logical_to_mesh_sharding``. ``model``-mapped dims give
+Megatron-style TP — column-parallel QKV/MLP-in, row-parallel
+proj/MLP-out; XLA inserts the reduce-scatter/all-reduce pair implied by
+the shardings.
+"""
+
+from __future__ import annotations
+
+LOGICAL_RULES = (
+    ("batch", ("replica", "data")),
+    ("seq", None),  # sequence axis sharding is handled by ring attention
+    ("embed", None),
+    ("heads", "model"),
+    ("head_dim", None),
+    ("mlp", "model"),
+    ("classes", None),
+    # LM tied embedding (models/transformer_lm.py): replicated — its
+    # matmuls contract over "embed"; shard over "model" only at vocab
+    # sizes where the table dominates memory.
+    ("vocab", None),
+)
+
+DATA_PARALLEL_RULES = tuple(
+    (name, ("replica", "data") if name == "batch" else None)
+    for name, _ in LOGICAL_RULES
+)

@@ -38,27 +38,11 @@ _VARIANTS = {
     "h": (1280, 32, 16, 5120),
 }
 
-# Logical-axis -> mesh-axis rules. The pjit engine passes these to
-# nn.logical_to_mesh_sharding. "model"-mapped dims give Megatron-style TP:
-# column-parallel QKV/MLP-in, row-parallel proj/MLP-out (XLA inserts the
-# reduce-scatter/all-reduce pair from the shardings).
-LOGICAL_RULES = (
-    ("batch", ("replica", "data")),
-    ("seq", None),  # sequence axis sharding is handled by ring attention
-    ("embed", None),
-    ("heads", "model"),
-    ("head_dim", None),
-    ("mlp", "model"),
-    ("classes", None),
-    # LM tied embedding (models/transformer_lm.py): replicated — its
-    # matmuls contract over "embed"; shard over "model" only at vocab
-    # sizes where the table dominates memory.
-    ("vocab", None),
-)
-
-DATA_PARALLEL_RULES = tuple(
-    (name, ("replica", "data") if name == "batch" else None)
-    for name, _ in LOGICAL_RULES
+# Model-neutral rules table (models/sharding.py), re-exported here for
+# backward compatibility — importing from models.sharding is preferred.
+from distributeddeeplearning_tpu.models.sharding import (  # noqa: F401
+    DATA_PARALLEL_RULES,
+    LOGICAL_RULES,
 )
 
 

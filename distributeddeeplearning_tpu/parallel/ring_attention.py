@@ -61,14 +61,22 @@ def ring_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected BTHD [b, t, h, d], got shape {q.shape}")
-    q_vma = getattr(jax.typeof(q), "vma", None)
-    if q_vma is not None and q_vma and axis_name not in q_vma:
-        # Bound-but-unsharded axis: every device would treat its full
-        # sequence as shard i's tokens and silently compute garbage.
-        raise ValueError(
-            f"q does not vary over {axis_name!r} (vma={set(q_vma)}): the "
-            "sequence must actually be sharded over the ring axis"
-        )
+    # Bound-but-unsharded axis: every device would treat its full
+    # sequence as shard i's tokens and silently compute garbage. Only
+    # checkable when vma tracking is on — probe with a pcast, which
+    # acquires the axis iff the surrounding shard_map checks vma.
+    probe = getattr(
+        jax.typeof(lax.pcast(jnp.zeros(()), axis_name, to="varying")),
+        "vma",
+        frozenset(),
+    )
+    if axis_name in (probe or ()):
+        q_vma = getattr(jax.typeof(q), "vma", frozenset()) or frozenset()
+        if axis_name not in q_vma:
+            raise ValueError(
+                f"q does not vary over {axis_name!r} (vma={set(q_vma)}): "
+                "the sequence must actually be sharded over the ring axis"
+            )
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape

@@ -85,3 +85,48 @@ def test_max_to_keep(tmp_path, mesh8):
     with pytest.raises(Exception):
         mgr.restore(fresh, epoch=0)  # garbage-collected
     mgr.close()
+
+
+def test_tp_sharded_state_roundtrip(tmp_path):
+    """Checkpoint/resume under tensor parallelism: a TP-sharded state
+    saves and restores onto the mesh with its shardings intact."""
+    import jax
+    import optax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.models.vit import LOGICAL_RULES, ViT
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+    from distributeddeeplearning_tpu.training.pjit_step import (
+        create_sharded_train_state,
+    )
+
+    mesh = create_mesh(axes=("data", "model"), shape=(2, 4))
+    cfg = TrainConfig(num_classes=10, image_size=16, compute_dtype="float32")
+    model = ViT(variant="ti", patch_size=16, num_classes=10, dtype=jnp.float32)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = create_sharded_train_state(
+        model, cfg, tx, mesh, LOGICAL_RULES, input_shape=(1, 16, 16, 3)
+    )
+    qkv_before = state.params["block0"]["attn"]["qkv"]["kernel"]
+    assert "model" in tuple(qkv_before.sharding.spec)
+
+    mgr = CheckpointManager(str(tmp_path / "tp_ckpt"))
+    mgr.save(0, state, force=True)
+    mgr.wait()
+    mgr.close()
+
+    # restore into a freshly-initialized (different-rng) sharded state
+    mgr2 = CheckpointManager(str(tmp_path / "tp_ckpt"))
+    other = create_sharded_train_state(
+        model, cfg, tx, mesh, LOGICAL_RULES, input_shape=(1, 16, 16, 3),
+        rng=jax.random.PRNGKey(123),
+    )
+    restored, epoch = mgr2.maybe_restore(other)
+    mgr2.close()
+    assert epoch == 1  # resume epoch = saved epoch + 1
+    qkv_after = restored.params["block0"]["attn"]["qkv"]["kernel"]
+    assert tuple(qkv_after.sharding.spec) == tuple(qkv_before.sharding.spec)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(qkv_after)),
+        np.asarray(jax.device_get(qkv_before)),
+    )

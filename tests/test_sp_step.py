@@ -146,3 +146,19 @@ def test_sp_step_rejects_overlong_global_sequence(sp_mesh):
             state,
             (jax.device_put(tokens, spec), jax.device_put(labels, spec)),
         )
+
+
+def test_ring_rejects_unsharded_sequence(sp_mesh):
+    """A bound-but-unsharded ring axis must raise, not compute garbage."""
+    from distributeddeeplearning_tpu.parallel.ring_attention import ring_attention
+
+    def f(q):
+        return ring_attention(q, q, q, axis_name="seq")
+
+    q = jnp.zeros((2, 8, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="must actually be sharded"):
+        jax.jit(
+            jax.shard_map(
+                f, mesh=sp_mesh, in_specs=P(), out_specs=P()
+            )
+        )(q)
