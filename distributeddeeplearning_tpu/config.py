@@ -83,6 +83,10 @@ class TrainConfig:
     distributed: bool = False
     mesh_shape: Optional[Tuple[int, ...]] = None  # None → all devices on 'data'
     mesh_axes: Tuple[str, ...] = ("data",)
+    # Training engine: "dp" = shard_map data-parallel (reference-parity
+    # runtime); "pjit" = GSPMD engine consuming logical-axis annotations
+    # (tensor parallelism over a mesh with a "model" axis).
+    engine: str = "dp"
 
     # Bookkeeping
     seed: int = 42  # reference _SEED=42 (PyTorch :274-277, TF fake data :284)
@@ -136,6 +140,8 @@ class TrainConfig:
             kw["model"] = e["MODEL"]
         if "ATTN_IMPL" in e:
             kw["attn_impl"] = e["ATTN_IMPL"]
+        if "ENGINE" in e:
+            kw["engine"] = e["ENGINE"]
         if "SEED" in e:
             kw["seed"] = int(e["SEED"])
         # Smoke-test knobs (not in the reference contract): shrink the

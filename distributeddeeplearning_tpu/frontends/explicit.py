@@ -65,23 +65,52 @@ def setup(
     the explicit analogue of reference ``main()`` setup (:267-338).
 
     ``input_shape``/``input_dtype`` override the image init contract for
-    non-image models (LM: ``(1, seq_len)``, ``jnp.int32``)."""
+    non-image models (LM: ``(1, seq_len)``, ``jnp.int32``).
+
+    ``config.engine="pjit"`` builds the GSPMD pieces instead: state
+    sharded at birth per the logical rules, pjit train/eval steps."""
     mesh = mesh if mesh is not None else data_parallel_mesh()
     spe = steps_per_epoch or config.steps_per_epoch()
     tx, schedule = create_optimizer(config, spe)
-    state = replicate_state(
-        create_train_state(
-            model, config, tx, input_shape=input_shape, input_dtype=input_dtype
-        ),
-        mesh,
-    )
+    if config.engine == "pjit":
+        import jax.numpy as jnp
+
+        from distributeddeeplearning_tpu.models.sharding import LOGICAL_RULES
+        from distributeddeeplearning_tpu.training.pjit_step import (
+            create_sharded_train_state,
+            make_pjit_eval_step,
+            make_pjit_train_step,
+        )
+
+        state = create_sharded_train_state(
+            model,
+            config,
+            tx,
+            mesh,
+            LOGICAL_RULES,
+            input_shape=input_shape,
+            input_dtype=input_dtype if input_dtype is not None else jnp.float32,
+        )
+        train_step = make_pjit_train_step(model, tx, mesh, config)
+        eval_step = make_pjit_eval_step(model, mesh)
+    elif config.engine == "dp":
+        state = replicate_state(
+            create_train_state(
+                model, config, tx, input_shape=input_shape, input_dtype=input_dtype
+            ),
+            mesh,
+        )
+        train_step = make_train_step(model, tx, mesh, config)
+        eval_step = make_eval_step(model, mesh)
+    else:
+        raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
     pieces = Pieces(
         model=model,
         config=config,
         mesh=mesh,
         tx=tx,
-        train_step=make_train_step(model, tx, mesh, config),
-        eval_step=make_eval_step(model, mesh),
+        train_step=train_step,
+        eval_step=eval_step,
         lr_schedule=schedule,
     )
     return pieces, state

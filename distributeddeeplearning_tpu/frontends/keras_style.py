@@ -140,10 +140,23 @@ class Model:
 
         if self._state is None:
             tx, _ = create_optimizer(self.config, steps_per_epoch=1)
-            state = create_train_state(self.module, self.config, tx)
-            self._state = replicate_state(
-                state, self.mesh if self.mesh is not None else data_parallel_mesh()
-            )
+            mesh = self.mesh if self.mesh is not None else data_parallel_mesh()
+            if self.config.engine == "pjit":
+                # Restore target must carry the TP shardings, or a later
+                # fit() would train with silently-replicated params.
+                from distributeddeeplearning_tpu.models.sharding import (
+                    LOGICAL_RULES,
+                )
+                from distributeddeeplearning_tpu.training.pjit_step import (
+                    create_sharded_train_state,
+                )
+
+                self._state = create_sharded_train_state(
+                    self.module, self.config, tx, mesh, LOGICAL_RULES
+                )
+            else:
+                state = create_train_state(self.module, self.config, tx)
+                self._state = replicate_state(state, mesh)
         mgr = CheckpointManager(directory)
         self._state, _ = mgr.maybe_restore(self._state)
         mgr.close()

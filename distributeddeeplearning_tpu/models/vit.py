@@ -5,11 +5,12 @@ configs ("ViT-B/16 on ImageNet — non-conv allreduce workload, v5e-64").
 Design is TPU-first throughout:
 
 * Every weight is annotated with **logical axes** via
-  ``nn.with_logical_partitioning``; ``models.vit.LOGICAL_RULES`` maps
-  them onto mesh axes so the same module runs pure-DP (rules map model
-  dims to None) or tensor-parallel (attention heads + MLP hidden sharded
-  over ``model``) without touching the module. The pjit engine
-  (``training/pjit_step.py``) consumes these annotations.
+  ``nn.with_logical_partitioning``; the model-neutral rules table
+  (``models/sharding.py``) maps them onto mesh axes so the same module
+  runs pure-DP (rules map model dims to None) or tensor-parallel
+  (attention heads + MLP hidden sharded over ``model``) without touching
+  the module. The pjit engine (``training/pjit_step.py``) consumes
+  these annotations.
 * Attention goes through ``ops.dot_product_attention`` so the impl can
   be swapped (XLA einsum / Pallas flash kernel / ring sequence-parallel)
   per config.

@@ -98,14 +98,38 @@ def fit(
     epochs = epochs if epochs is not None else config.epochs
     steps_per_epoch = train_data.steps_per_epoch
 
+    if config.engine not in ("dp", "pjit"):
+        raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
+    use_pjit = config.engine == "pjit"
     if tx is None:
         tx, _ = create_optimizer(config, steps_per_epoch)
     if state is None:
         shape, dtype = _init_spec(train_data)
-        state = create_train_state(
-            model, config, tx, input_shape=shape, input_dtype=dtype
-        )
-    state = replicate_state(state, mesh)
+        if use_pjit:
+            # Sharded-at-birth init: logical annotations (heads/mlp ->
+            # "model") map onto the mesh; unannotated models replicate.
+            import jax.numpy as jnp
+
+            from distributeddeeplearning_tpu.models.sharding import LOGICAL_RULES
+            from distributeddeeplearning_tpu.training.pjit_step import (
+                create_sharded_train_state,
+            )
+
+            state = create_sharded_train_state(
+                model,
+                config,
+                tx,
+                mesh,
+                LOGICAL_RULES,
+                input_shape=shape,
+                input_dtype=dtype if dtype is not None else jnp.float32,
+            )
+        else:
+            state = create_train_state(
+                model, config, tx, input_shape=shape, input_dtype=dtype
+            )
+    if not use_pjit:
+        state = replicate_state(state, mesh)
 
     from distributeddeeplearning_tpu.training.callbacks import (
         ModelCheckpointCallback,
@@ -151,8 +175,19 @@ def fit(
         if start_epoch:
             log.info("resuming from epoch %d", start_epoch)
 
-    train_step = make_train_step(model, tx, mesh, config)
-    eval_step = make_eval_step(model, mesh) if eval_data is not None else None
+    if use_pjit:
+        from distributeddeeplearning_tpu.training.pjit_step import (
+            make_pjit_eval_step,
+            make_pjit_train_step,
+        )
+
+        train_step = make_pjit_train_step(model, tx, mesh, config)
+        eval_step = (
+            make_pjit_eval_step(model, mesh) if eval_data is not None else None
+        )
+    else:
+        train_step = make_train_step(model, tx, mesh, config)
+        eval_step = make_eval_step(model, mesh) if eval_data is not None else None
 
     history: List[Dict[str, float]] = []
     global_batch = config.global_batch_size
@@ -238,7 +273,18 @@ def evaluate(
     *,
     mesh=None,
 ) -> Dict[str, float]:
-    """Standalone evaluation (reference ``validate()`` PyTorch ``:224-239``)."""
+    """Standalone evaluation (reference ``validate()`` PyTorch ``:224-239``).
+
+    Dispatches on ``config.engine`` like ``fit`` — a TP-sharded state
+    must not pass through the shard_map step's replicated in_spec (it
+    would all-gather the params on every device)."""
     mesh = mesh if mesh is not None else data_parallel_mesh()
-    eval_step = make_eval_step(model, mesh)
+    if config.engine == "pjit":
+        from distributeddeeplearning_tpu.training.pjit_step import (
+            make_pjit_eval_step,
+        )
+
+        eval_step = make_pjit_eval_step(model, mesh)
+    else:
+        eval_step = make_eval_step(model, mesh)
     return _run_eval(eval_step, state, eval_data, mesh, config)

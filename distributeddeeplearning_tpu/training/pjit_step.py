@@ -5,7 +5,7 @@ data-parallel runtime. This engine is the scale-up path the reference
 never had (its README names model parallelism as future work,
 ``/root/reference/README.md:21``): models annotate weights with *logical*
 axes (``nn.with_logical_partitioning`` — see ``models/vit.py``), a rules
-table maps logical axes onto mesh axes (``models.vit.LOGICAL_RULES``),
+table maps logical axes onto mesh axes (``models/sharding.py``),
 and XLA's SPMD partitioner inserts the collectives implied by the
 shardings — Megatron-style column/row-parallel matmuls become
 all-reduce / reduce-scatter pairs on ICI without any hand-written
