@@ -142,6 +142,15 @@ class TrainConfig:
             kw["attn_impl"] = e["ATTN_IMPL"]
         if "ENGINE" in e:
             kw["engine"] = e["ENGINE"]
+        # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)
+        if "MESH_AXES" in e:
+            kw["mesh_axes"] = tuple(
+                a.strip() for a in e["MESH_AXES"].split(",") if a.strip()
+            )
+        if "MESH_SHAPE" in e:
+            kw["mesh_shape"] = tuple(
+                int(s) for s in e["MESH_SHAPE"].split(",") if s.strip()
+            )
         if "SEED" in e:
             kw["seed"] = int(e["SEED"])
         # Smoke-test knobs (not in the reference contract): shrink the

@@ -69,31 +69,25 @@ def setup(
 
     ``config.engine="pjit"`` builds the GSPMD pieces instead: state
     sharded at birth per the logical rules, pjit train/eval steps."""
-    mesh = mesh if mesh is not None else data_parallel_mesh()
+    from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+    use_pjit, mesh = resolve_engine(config, mesh)
     spe = steps_per_epoch or config.steps_per_epoch()
     tx, schedule = create_optimizer(config, spe)
-    if config.engine == "pjit":
-        import jax.numpy as jnp
-
-        from distributeddeeplearning_tpu.models.sharding import LOGICAL_RULES
+    if use_pjit:
         from distributeddeeplearning_tpu.training.pjit_step import (
-            create_sharded_train_state,
+            build_pjit_state,
             make_pjit_eval_step,
             make_pjit_train_step,
         )
 
-        state = create_sharded_train_state(
-            model,
-            config,
-            tx,
-            mesh,
-            LOGICAL_RULES,
-            input_shape=input_shape,
-            input_dtype=input_dtype if input_dtype is not None else jnp.float32,
+        state = build_pjit_state(
+            model, config, tx, mesh,
+            input_shape=input_shape, input_dtype=input_dtype,
         )
         train_step = make_pjit_train_step(model, tx, mesh, config)
         eval_step = make_pjit_eval_step(model, mesh)
-    elif config.engine == "dp":
+    else:
         state = replicate_state(
             create_train_state(
                 model, config, tx, input_shape=input_shape, input_dtype=input_dtype
@@ -102,8 +96,6 @@ def setup(
         )
         train_step = make_train_step(model, tx, mesh, config)
         eval_step = make_eval_step(model, mesh)
-    else:
-        raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
     pieces = Pieces(
         model=model,
         config=config,

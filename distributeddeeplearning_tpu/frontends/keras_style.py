@@ -139,21 +139,18 @@ class Model:
         from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
 
         if self._state is None:
+            from distributeddeeplearning_tpu.training.loop import resolve_engine
+
             tx, _ = create_optimizer(self.config, steps_per_epoch=1)
-            mesh = self.mesh if self.mesh is not None else data_parallel_mesh()
-            if self.config.engine == "pjit":
+            use_pjit, mesh = resolve_engine(self.config, self.mesh)
+            if use_pjit:
                 # Restore target must carry the TP shardings, or a later
                 # fit() would train with silently-replicated params.
-                from distributeddeeplearning_tpu.models.sharding import (
-                    LOGICAL_RULES,
-                )
                 from distributeddeeplearning_tpu.training.pjit_step import (
-                    create_sharded_train_state,
+                    build_pjit_state,
                 )
 
-                self._state = create_sharded_train_state(
-                    self.module, self.config, tx, mesh, LOGICAL_RULES
-                )
+                self._state = build_pjit_state(self.module, self.config, tx, mesh)
             else:
                 state = create_train_state(self.module, self.config, tx)
                 self._state = replicate_state(state, mesh)

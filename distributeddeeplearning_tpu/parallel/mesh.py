@@ -89,6 +89,15 @@ def create_mesh(
     return Mesh(device_array, config.axes)
 
 
+def mesh_from_config(config) -> Mesh:
+    """Build the mesh a ``TrainConfig`` describes: ``mesh_axes`` ×
+    ``mesh_shape`` when set (e.g. ``MESH_AXES=data,model MESH_SHAPE=2,4``
+    for the pjit engine), else all devices on ``data``."""
+    if config.mesh_shape is not None:
+        return create_mesh(axes=config.mesh_axes, shape=config.mesh_shape)
+    return data_parallel_mesh()
+
+
 def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
     """All devices on the ``data`` axis (reference parity topology, §2b)."""
     devs = jax.devices()[: n_devices or len(jax.devices())]

@@ -56,6 +56,19 @@ class FitResult:
     images_per_sec: float
 
 
+def resolve_engine(config, mesh=None):
+    """Validate ``config.engine`` and resolve the mesh (explicit arg wins;
+    else ``config.mesh_axes``/``mesh_shape``; else all-devices DP). One
+    helper for every entry point so an unknown engine can never fall
+    through to the wrong step."""
+    from distributeddeeplearning_tpu.parallel.mesh import mesh_from_config
+
+    if config.engine not in ("dp", "pjit"):
+        raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
+    mesh = mesh if mesh is not None else mesh_from_config(config)
+    return config.engine == "pjit", mesh
+
+
 def _init_spec(data):
     """Infer the model-init input signature from the dataset so every
     front-end can train token models: a dataset exposing ``seq_len``
@@ -94,13 +107,10 @@ def fit(
     averaged, Keras ``:344-353``), and prints the ``_log_summary`` block.
     """
     log = get_logger()
-    mesh = mesh if mesh is not None else data_parallel_mesh()
+    use_pjit, mesh = resolve_engine(config, mesh)
     epochs = epochs if epochs is not None else config.epochs
     steps_per_epoch = train_data.steps_per_epoch
 
-    if config.engine not in ("dp", "pjit"):
-        raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
-    use_pjit = config.engine == "pjit"
     if tx is None:
         tx, _ = create_optimizer(config, steps_per_epoch)
     if state is None:
@@ -108,21 +118,12 @@ def fit(
         if use_pjit:
             # Sharded-at-birth init: logical annotations (heads/mlp ->
             # "model") map onto the mesh; unannotated models replicate.
-            import jax.numpy as jnp
-
-            from distributeddeeplearning_tpu.models.sharding import LOGICAL_RULES
             from distributeddeeplearning_tpu.training.pjit_step import (
-                create_sharded_train_state,
+                build_pjit_state,
             )
 
-            state = create_sharded_train_state(
-                model,
-                config,
-                tx,
-                mesh,
-                LOGICAL_RULES,
-                input_shape=shape,
-                input_dtype=dtype if dtype is not None else jnp.float32,
+            state = build_pjit_state(
+                model, config, tx, mesh, input_shape=shape, input_dtype=dtype
             )
         else:
             state = create_train_state(
@@ -278,8 +279,8 @@ def evaluate(
     Dispatches on ``config.engine`` like ``fit`` — a TP-sharded state
     must not pass through the shard_map step's replicated in_spec (it
     would all-gather the params on every device)."""
-    mesh = mesh if mesh is not None else data_parallel_mesh()
-    if config.engine == "pjit":
+    use_pjit, mesh = resolve_engine(config, mesh)
+    if use_pjit:
         from distributeddeeplearning_tpu.training.pjit_step import (
             make_pjit_eval_step,
         )

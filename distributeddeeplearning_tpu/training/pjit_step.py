@@ -77,13 +77,23 @@ def logical_shardings(
     init; unannotated params (ResNet et al.) come back fully replicated.
     """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if input_dtype is None:
+        input_dtype = jnp.float32
     abstract = jax.eval_shape(
         functools.partial(model.init, train=False),
         rng,
         jnp.zeros(input_shape, input_dtype),
     )
     logical_spec = nn.get_partition_spec(abstract)
-    shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+    try:
+        shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+    except ValueError as e:
+        raise ValueError(
+            f"model's logical axes don't fit mesh axes {mesh.axis_names}: "
+            f"{e}. The pjit engine with an annotated model needs a 'model' "
+            "axis — create_mesh(axes=('data', 'model'), shape=(d, m)) or "
+            "set MESH_AXES=data,model MESH_SHAPE=d,m"
+        ) from e
     return abstract, shardings["params"]
 
 
@@ -96,11 +106,14 @@ def create_sharded_train_state(
     *,
     input_shape: Optional[Tuple[int, ...]] = None,
     rng: Optional[jax.Array] = None,
-    input_dtype=jnp.float32,
+    input_dtype=None,
 ) -> TrainState:
     """Seeded init, sharded at birth (no replicated intermediate).
-    ``input_shape``/``input_dtype``: token models pass ((1, T), int32)."""
+    ``input_shape``/``input_dtype``: token models pass ((1, T), int32);
+    ``None`` dtype means float32 images."""
     rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+    if input_dtype is None:
+        input_dtype = jnp.float32
     shape = input_shape or (1, config.image_size, config.image_size, 3)
     _, param_shardings = logical_shardings(
         model, mesh, rules, shape, rng, input_dtype=input_dtype
@@ -247,3 +260,28 @@ def make_pjit_eval_step(
         return jitted(state, batch)
 
     return step
+
+
+def build_pjit_state(
+    model,
+    config: TrainConfig,
+    tx,
+    mesh: Mesh,
+    *,
+    input_shape: Optional[Tuple[int, ...]] = None,
+    input_dtype=None,
+) -> TrainState:
+    """One construction point for engine='pjit' state (used by loop.fit,
+    the explicit front-end, and Keras load_weights): sharded-at-birth
+    init under the model-neutral rules table."""
+    from distributeddeeplearning_tpu.models.sharding import LOGICAL_RULES
+
+    return create_sharded_train_state(
+        model,
+        config,
+        tx,
+        mesh,
+        LOGICAL_RULES,
+        input_shape=input_shape,
+        input_dtype=input_dtype,
+    )

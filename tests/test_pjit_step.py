@@ -253,3 +253,26 @@ def test_pjit_evaluate_uses_pjit_eval(tp_mesh):
     metrics = loop.evaluate(_vit(), cfg, val, state, mesh=tp_mesh)
     assert metrics["samples"] == 24.0
     assert np.isfinite(metrics["loss"])
+
+
+def test_engine_validation_and_config_mesh(devices):
+    """Unknown engine rejected everywhere; mesh_axes/mesh_shape from
+    config are actually consumed; annotated-model-on-wrong-mesh errors
+    clearly."""
+    from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        resolve_engine(CFG.replace(engine="gspmd"))
+    # config-driven mesh (the ENGINE=pjit MESH_AXES=... env path)
+    cfg = CFG.replace(
+        engine="pjit", mesh_axes=("data", "model"), mesh_shape=(2, 4)
+    )
+    use_pjit, mesh = resolve_engine(cfg)
+    assert use_pjit and mesh.shape == {"data": 2, "model": 4}
+    # annotated model on a mesh without a 'model' axis: clear guidance
+    from distributeddeeplearning_tpu.training.pjit_step import build_pjit_state
+
+    dp_cfg = CFG.replace(engine="pjit")  # no mesh_shape -> pure-data mesh
+    _, dp_mesh = resolve_engine(dp_cfg)
+    with pytest.raises(ValueError, match="MESH_AXES=data,model"):
+        build_pjit_state(_vit(), dp_cfg, optax.sgd(0.1), dp_mesh)
