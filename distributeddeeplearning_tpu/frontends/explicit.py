@@ -26,7 +26,6 @@ import optax
 
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device
-from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
 from distributeddeeplearning_tpu.training.optimizer import create_optimizer
 from distributeddeeplearning_tpu.training.state import TrainState
 from distributeddeeplearning_tpu.training.train_step import (

@@ -136,7 +136,6 @@ class Model:
             create_train_state,
             replicate_state,
         )
-        from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
 
         if self._state is None:
             from distributeddeeplearning_tpu.training.loop import resolve_engine

@@ -92,9 +92,18 @@ def create_mesh(
 def mesh_from_config(config) -> Mesh:
     """Build the mesh a ``TrainConfig`` describes: ``mesh_axes`` ×
     ``mesh_shape`` when set (e.g. ``MESH_AXES=data,model MESH_SHAPE=2,4``
-    for the pjit engine), else all devices on ``data``."""
+    for the pjit engine), axes-only otherwise (all devices on the last
+    axis), else all devices on ``data``."""
     if config.mesh_shape is not None:
+        if len(config.mesh_shape) != len(config.mesh_axes):
+            raise ValueError(
+                f"MESH_SHAPE {config.mesh_shape} and MESH_AXES "
+                f"{config.mesh_axes} must have the same length"
+            )
         return create_mesh(axes=config.mesh_axes, shape=config.mesh_shape)
+    if tuple(config.mesh_axes) != ("data",):
+        # MESH_AXES without MESH_SHAPE: let create_mesh infer the split.
+        return create_mesh(axes=config.mesh_axes)
     return data_parallel_mesh()
 
 

@@ -20,7 +20,6 @@ import optax
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device
 from distributeddeeplearning_tpu.parallel import collectives
-from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
 from distributeddeeplearning_tpu.training.callbacks import (
     Callback,
     CallbackList,

@@ -69,7 +69,7 @@ def logical_shardings(
     rules: Sequence[Tuple[str, Any]],
     input_shape: Tuple[int, ...],
     rng: Optional[jax.Array] = None,
-    input_dtype=jnp.float32,
+    input_dtype=None,
 ) -> Tuple[PyTree, PyTree]:
     """(abstract_variables, NamedSharding tree for ``params``).
 
@@ -77,8 +77,7 @@ def logical_shardings(
     init; unannotated params (ResNet et al.) come back fully replicated.
     """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    if input_dtype is None:
-        input_dtype = jnp.float32
+    # input_dtype=None -> float32 (jnp.zeros' own default)
     abstract = jax.eval_shape(
         functools.partial(model.init, train=False),
         rng,
@@ -112,8 +111,6 @@ def create_sharded_train_state(
     ``input_shape``/``input_dtype``: token models pass ((1, T), int32);
     ``None`` dtype means float32 images."""
     rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
-    if input_dtype is None:
-        input_dtype = jnp.float32
     shape = input_shape or (1, config.image_size, config.image_size, 3)
     _, param_shardings = logical_shardings(
         model, mesh, rules, shape, rng, input_dtype=input_dtype

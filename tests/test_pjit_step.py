@@ -276,3 +276,28 @@ def test_engine_validation_and_config_mesh(devices):
     _, dp_mesh = resolve_engine(dp_cfg)
     with pytest.raises(ValueError, match="MESH_AXES=data,model"):
         build_pjit_state(_vit(), dp_cfg, optax.sgd(0.1), dp_mesh)
+
+
+def test_estimator_frontend_with_pjit_engine(tp_mesh):
+    """Third front-end x pjit engine cell: Estimator trains and evaluates
+    on a (data, model) mesh with sharded params."""
+    from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
+    from distributeddeeplearning_tpu.frontends import Estimator, RunConfig
+
+    cfg = CFG.replace(engine="pjit")
+
+    def data(c, length=32, exact=False):
+        return SyntheticImageDataset(
+            length=length, global_batch_size=c.global_batch_size,
+            image_size=16, num_classes=10, num_physical_batches=2,
+            exact=exact,
+        )
+
+    est = Estimator(lambda c: _vit(), cfg, RunConfig(mesh=tp_mesh))
+    est.train(data, epochs=1)
+    assert int(jax.device_get(est.state.step)) == 2  # 32/(2*8)
+    qkv = est.state.params["block0"]["attn"]["qkv"]["kernel"]
+    assert "model" in tuple(qkv.sharding.spec)
+    metrics = est.evaluate(lambda c: data(c, length=24, exact=True))
+    assert metrics["samples"] == 24.0
+    assert np.isfinite(metrics["loss"])
