@@ -21,7 +21,7 @@ SCRIPT            ?= examples/imagenet_keras_tpu.py
 JOB               ?= ddl-train
 PY                ?= python
 
-.PHONY: build push run smoke test test-fast bench provision setup \
+.PHONY: build push run smoke test test-fast bench native provision setup \
         submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -50,6 +50,11 @@ test-fast:
 
 bench:
 	$(PY) bench.py
+
+## Native IO tier (built on demand by the Python bindings too)
+native:
+	g++ -O3 -std=c++17 -shared -fPIC -o native/libddl_native.so \
+	    native/ddl_native.cc -lpthread
 
 ## Cluster tier (reference 01_CreateResources / 01_Train*)
 # --tpu/--zone live on the PARENT parser (before the subcommand) and are

@@ -52,6 +52,9 @@ class TrainConfig:
     # Attention implementation for attention models (ViT):
     # "xla" einsum | "pallas" flash kernel | "ring" sequence-parallel.
     attn_impl: str = "xla"
+    # Mixture-of-Experts width for MoE-capable models (the LM families):
+    # None keeps each model's own default (8 for lm_moe_*, dense for lm_*).
+    moe_experts: Optional[int] = None
 
     # Optimization — reference constants: LR 0.001 × world size
     # (TF :154, PyTorch :333), momentum 0.9, L2 5e-5 (Keras :97-116),
@@ -94,6 +97,18 @@ class TrainConfig:
     checkpoint_every_epochs: int = 1
     resume: bool = True
     log_every_steps: int = 100  # PyTorch logs per-100-steps (:219-221)
+
+    def model_kwargs(self) -> dict:
+        """The ``get_model`` kwargs this config implies — one construction
+        point shared by every front-end (keras/estimator/explicit)."""
+        kw = dict(
+            num_classes=self.num_classes,
+            dtype=self.compute_dtype,
+            attn_impl=self.attn_impl,
+        )
+        if self.moe_experts is not None:
+            kw["moe_experts"] = self.moe_experts
+        return kw
 
     @property
     def global_batch_size(self) -> int:
@@ -140,6 +155,8 @@ class TrainConfig:
             kw["model"] = e["MODEL"]
         if "ATTN_IMPL" in e:
             kw["attn_impl"] = e["ATTN_IMPL"]
+        if "MOE_EXPERTS" in e:
+            kw["moe_experts"] = int(e["MOE_EXPERTS"])
         if "ENGINE" in e:
             kw["engine"] = e["ENGINE"]
         # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)

@@ -267,9 +267,13 @@ class TFRecordImageNetDataset:
         if length is None:
             length = _read_count_metadata(files)
         if length is None:
-            # Last resort: a full serial scan. prepare.py writes count.txt
-            # precisely so real runs never hit this.
-            length = sum(1 for f in files for _ in tf.data.TFRecordDataset(f))
+            # Last resort: a framing-only scan via the native TFRecord
+            # indexer (native/ddl_native.cc) — no proto parsing, no
+            # tf.data graph. prepare.py writes count.txt precisely so
+            # real runs rarely hit even this.
+            from distributeddeeplearning_tpu.native import count_records
+
+            length = sum(count_records(f) for f in files)
         self.length = length
         if train:
             self.steps_per_epoch = max(length // global_batch_size, 1)

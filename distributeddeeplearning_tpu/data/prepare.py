@@ -70,10 +70,17 @@ def write_tfrecords(
     Writes ``{prefix}-{shard:05d}-of-{num_shards:05d}`` files whose
     records carry ``image/encoded`` (the original JPEG bytes — no
     re-encode) and ``image/class/label``. Returns (num_images, classes).
-    """
-    import tensorflow as tf
 
+    The write path is TF-free: records are serialized by the first-party
+    Example codec (``native/example_proto.py``) and framed by the native
+    TFRecord writer (``native/ddl_native.cc`` — crc32c in C++, pure-Python
+    fallback otherwise); output is byte-compatible with
+    ``tf.io.TFRecordWriter`` and readable by ``tf.data`` (asserted in
+    ``tests/test_native.py``).
+    """
     from distributeddeeplearning_tpu.data.imagenet import _list_samples
+    from distributeddeeplearning_tpu.native import write_tfrecord
+    from distributeddeeplearning_tpu.native.example_proto import encode_example
 
     samples, classes = _list_samples(src_dir)
     if limit:
@@ -82,27 +89,24 @@ def write_tfrecords(
     # One shard (and one open fd) at a time — a 1024-writer fan-out would
     # blow the default ulimit. Samples are interleaved across shards so
     # each shard stays class-balanced.
+    chunk = 256  # bounded memory: ~chunk×image_size held at once, not a shard
     for shard in range(num_shards):
         shard_path = os.path.join(
             out_dir, f"{prefix}-{shard:05d}-of-{num_shards:05d}"
         )
-        with tf.io.TFRecordWriter(shard_path) as writer:
-            for path, label in samples[shard::num_shards]:
+        shard_samples = samples[shard::num_shards]
+        write_tfrecord(shard_path, [])  # create/truncate
+        for start in range(0, len(shard_samples), chunk):
+            payloads = []
+            for path, label in shard_samples[start : start + chunk]:
                 with open(path, "rb") as f:
                     encoded = f.read()
-                ex = tf.train.Example(
-                    features=tf.train.Features(
-                        feature={
-                            "image/encoded": tf.train.Feature(
-                                bytes_list=tf.train.BytesList(value=[encoded])
-                            ),
-                            "image/class/label": tf.train.Feature(
-                                int64_list=tf.train.Int64List(value=[label])
-                            ),
-                        }
+                payloads.append(
+                    encode_example(
+                        {"image/encoded": encoded, "image/class/label": [label]}
                     )
                 )
-                writer.write(ex.SerializeToString())
+            write_tfrecord(shard_path, payloads, append=True)
     with open(os.path.join(out_dir, "classes.txt"), "w") as f:
         f.write("\n".join(classes) + "\n")
     with open(os.path.join(out_dir, "count.txt"), "w") as f:

@@ -93,9 +93,17 @@ class SyntheticImageDataset:
 
         rng = np.random.RandomState(seed)  # seed 42 parity (TF :284-287)
         pool_n = num_physical_batches * self.local_batch_size
-        self._images = rng.uniform(
-            -1.0, 1.0, size=(pool_n, image_size, image_size, channels)
-        ).astype(dtype)
+        # Pool fill goes through the native threaded counter-mode fill
+        # (native/ddl_native.cc; numpy fallback is bit-identical): the
+        # pool is GBs at bench batch sizes and RandomState.uniform is
+        # single-threaded. Deterministic in `seed` alone, like before.
+        from distributeddeeplearning_tpu.native import fill_uniform
+
+        self._images = (
+            fill_uniform(
+                (pool_n, image_size, image_size, channels), seed=seed
+            ) * np.float32(2.0) - np.float32(1.0)
+        ).astype(dtype, copy=False)
         self._labels = rng.randint(0, num_classes, size=(pool_n,)).astype(np.int32)
         # Virtual→physical translation index (reference data_generator.py:45).
         # Sized to the *local* share of the virtual length; offset by process

@@ -51,12 +51,7 @@ class Estimator:
         self.run_config = run_config or RunConfig(model_dir=self.config.model_dir)
         if isinstance(model_fn, str):
             name = model_fn
-            model_fn = lambda cfg: get_model(
-                name,
-                num_classes=cfg.num_classes,
-                dtype=cfg.compute_dtype,
-                attn_impl=cfg.attn_impl,
-            )
+            model_fn = lambda cfg: get_model(name, **cfg.model_kwargs())
         self.model = model_fn(self.config)
         self._state: Optional[TrainState] = None
         self._ckpt = None

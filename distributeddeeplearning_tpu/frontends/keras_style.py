@@ -30,12 +30,7 @@ class Model:
     def __init__(self, module_or_name, config: Optional[TrainConfig] = None, mesh=None):
         self.config = config or TrainConfig()
         self.module = (
-            get_model(
-                module_or_name,
-                num_classes=self.config.num_classes,
-                dtype=self.config.compute_dtype,
-                attn_impl=self.config.attn_impl,
-            )
+            get_model(module_or_name, **self.config.model_kwargs())
             if isinstance(module_or_name, str)
             else module_or_name
         )

@@ -28,14 +28,21 @@ from distributeddeeplearning_tpu.models.vit import ViT
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
 _ATTENTION_MODELS: set = set()
+_MOE_MODELS: set = set()
 
 
 def register_model(
-    name: str, factory: Callable[..., Any], *, attention: bool = False
+    name: str,
+    factory: Callable[..., Any],
+    *,
+    attention: bool = False,
+    moe: bool = False,
 ) -> None:
     _REGISTRY[name.lower()] = factory
     if attention:
         _ATTENTION_MODELS.add(name.lower())
+    if moe:
+        _MOE_MODELS.add(name.lower())
 
 
 def get_model(
@@ -44,6 +51,7 @@ def get_model(
     num_classes: int = None,
     dtype=jnp.bfloat16,
     attn_impl: str = None,
+    moe_experts: int = None,
     **kw,
 ):
     """Instantiate a model by name (e.g. ``"resnet50"``).
@@ -64,6 +72,8 @@ def get_model(
         dtype = jnp.dtype(dtype)
     if attn_impl is not None and key in _ATTENTION_MODELS:
         kw["attn_impl"] = attn_impl
+    if moe_experts is not None and key in _MOE_MODELS:
+        kw["moe_experts"] = moe_experts
     if num_classes is not None:
         kw["num_classes"] = num_classes
     return _REGISTRY[key](dtype=dtype, **kw)
@@ -97,6 +107,19 @@ for _v in ("tiny", "small", "base", "large"):
         (lambda v: (lambda num_classes=32_000, dtype=jnp.bfloat16, **kw: TransformerLM(
             variant=v, vocab_size=num_classes, dtype=dtype, **kw)))(_v),
         attention=True,
+        moe=True,  # dense by default; MOE_EXPERTS turns on routed FFNs
+    )
+    # MoE variant (expert-parallel tier, models/moe.py): every 2nd block's
+    # FFN routed over 8 experts by default; override via moe_experts=...
+    register_model(
+        f"lm_moe_{_v}",
+        (lambda v: (
+            lambda num_classes=32_000, dtype=jnp.bfloat16, moe_experts=8, **kw:
+            TransformerLM(
+                variant=v, vocab_size=num_classes, dtype=dtype,
+                moe_experts=moe_experts, **kw)))(_v),
+        attention=True,
+        moe=True,
     )
 
 # EfficientNet family (BASELINE.json config: EfficientNet-B4).

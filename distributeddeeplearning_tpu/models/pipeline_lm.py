@@ -1,0 +1,200 @@
+"""Stage-partitioned decoder LM — the pipeline-parallel (PP) model tier.
+
+Not in the reference (sync-DP only, ``/root/reference/README.md:14-21``).
+Pipeline parallelism is the one strategy that does not fit the "annotate
+weights, let GSPMD partition" mold: the *schedule* (microbatches flowing
+through stages) is the parallelism. So this tier splits the model
+explicitly:
+
+* ``EmbedIn``    — token + position embedding (lives on stage 0)
+* ``StageCore``  — ``depth/num_stages`` decoder blocks (one per stage;
+  the per-stage params are **stacked** on a leading ``[S, ...]`` axis and
+  sharded over the mesh's ``pipe`` axis, so each device physically holds
+  only its own stage's weights)
+* ``HeadOut``    — final LayerNorm + vocab projection (last stage)
+
+``PipelineLM`` is a thin param-container (not an ``nn.Module``): ``init``
+builds ``{"embed", "stages", "head"}`` with the stacked stage axis, and
+``apply_reference`` runs the exact same math sequentially on one device —
+the correctness oracle for the pipelined schedule in
+``training/pp_step.py`` (GPipe fill-drain over ``lax.scan`` +
+``ppermute``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models.transformer_lm import (
+    _VARIANTS,
+    DecoderBlock,
+)
+
+PyTree = Any
+
+
+class EmbedIn(nn.Module):
+    """[B, T] int32 tokens → [B, T, H] activations (stage-0 input)."""
+
+    vocab_size: int
+    hidden: int
+    max_seq_len: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        t = tokens.shape[-1]
+        if t > self.max_seq_len:
+            raise ValueError(f"sequence {t} exceeds max_seq_len {self.max_seq_len}")
+        embed = self.param(
+            "tok_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (self.vocab_size, self.hidden),
+            jnp.float32,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "seq", "embed")
+            ),
+            (1, self.max_seq_len, self.hidden),
+            jnp.float32,
+        )
+        x = embed[tokens].astype(self.dtype)
+        return x + pos[:, :t].astype(self.dtype)
+
+
+class StageCore(nn.Module):
+    """``n_layers`` decoder blocks — one pipeline stage's compute."""
+
+    n_layers: int
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for i in range(self.n_layers):
+            x = DecoderBlock(
+                self.num_heads,
+                self.mlp_dim,
+                self.dtype,
+                self.attn_impl,
+                self.dropout,
+                name=f"layer{i}",
+            )(x, train)
+        return x
+
+
+class HeadOut(nn.Module):
+    """Final LayerNorm + (untied) vocab projection (last stage)."""
+
+    vocab_size: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(
+            self.vocab_size,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)
+            ),
+            name="proj",
+        )(x).astype(jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineLM:
+    """Param container + reference semantics for a PP-partitioned LM.
+
+    ``num_stages`` must divide the depth (``n_layers`` overrides the
+    variant's depth — handy for tests and uneven hardware).
+    """
+
+    variant: str = "tiny"
+    vocab_size: int = 32_000
+    max_seq_len: int = 2048
+    num_stages: int = 2
+    n_layers: Optional[int] = None
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @property
+    def dims(self) -> Tuple[int, int, int, int]:
+        hidden, depth, heads, mlp_dim = _VARIANTS[self.variant]
+        if self.n_layers is not None:
+            depth = self.n_layers
+        return hidden, depth, heads, mlp_dim
+
+    @property
+    def layers_per_stage(self) -> int:
+        _, depth, _, _ = self.dims
+        if depth % self.num_stages:
+            raise ValueError(
+                f"depth {depth} not divisible by num_stages {self.num_stages}"
+            )
+        return depth // self.num_stages
+
+    def modules(self) -> Tuple[EmbedIn, StageCore, HeadOut]:
+        hidden, _, heads, mlp_dim = self.dims
+        embed = EmbedIn(self.vocab_size, hidden, self.max_seq_len, self.dtype)
+        core = StageCore(
+            self.layers_per_stage, heads, mlp_dim, self.dtype,
+            self.attn_impl, self.dropout,
+        )
+        head = HeadOut(self.vocab_size, self.dtype)
+        return embed, core, head
+
+    def init(self, rng: jax.Array, seq_len: int) -> PyTree:
+        """Seeded host init: ``{"embed", "stages" (stacked [S, ...]),
+        "head"}``, unboxed (plain arrays)."""
+        hidden, _, _, _ = self.dims
+        embed, core, head = self.modules()
+        r_embed, r_stages, r_head = jax.random.split(rng, 3)
+        tokens = jnp.zeros((1, seq_len), jnp.int32)
+        x = jnp.zeros((1, seq_len, hidden), self.dtype)
+        stage_keys = jax.random.split(r_stages, self.num_stages)
+        stage_init = functools.partial(core.init, train=False)
+        stages = jax.vmap(lambda k: nn.unbox(stage_init(k, x)["params"]))(
+            stage_keys
+        )
+        return {
+            "embed": nn.unbox(embed.init(r_embed, tokens)["params"]),
+            "stages": stages,
+            "head": nn.unbox(head.init(r_head, x)["params"]),
+        }
+
+    def stage_params(self, params: PyTree, s: int) -> PyTree:
+        return jax.tree.map(lambda a: a[s], params["stages"])
+
+    def apply_reference(
+        self, params: PyTree, tokens: jnp.ndarray, train: bool = False,
+        rngs=None,
+    ) -> jnp.ndarray:
+        """Sequential single-device forward — mathematically identical to
+        the pipelined schedule; the correctness oracle in tests."""
+        embed, core, head = self.modules()
+        x = embed.apply({"params": params["embed"]}, tokens)
+        for s in range(self.num_stages):
+            x = core.apply(
+                {"params": self.stage_params(params, s)}, x, train=train,
+                rngs=rngs,
+            )
+        return head.apply({"params": params["head"]}, x)

@@ -22,9 +22,31 @@ LOGICAL_RULES = (
     # matmuls contract over "embed"; shard over "model" only at vocab
     # sizes where the table dominates memory.
     ("vocab", None),
+    # MoE (models/moe.py): expert weights and expert-major activations
+    # shard over the mesh's "expert" axis; the dispatch einsum boundary
+    # becomes the token all-to-all.
+    ("expert", "expert"),
 )
 
 DATA_PARALLEL_RULES = tuple(
     (name, ("replica", "data") if name == "batch" else None)
     for name, _ in LOGICAL_RULES
 )
+
+
+def rules_for_mesh(mesh, rules=LOGICAL_RULES):
+    """Project a rules table onto a concrete mesh: any rule whose target
+    mesh axis (or every axis of a tuple target) is absent becomes
+    replicated. Lets one table serve pure-DP meshes (no ``model`` /
+    ``expert`` axis) and TP/EP meshes without per-model tables."""
+    present = set(mesh.axis_names)
+
+    def project(target):
+        if target is None:
+            return None
+        if isinstance(target, str):
+            return target if target in present else None
+        kept = tuple(a for a in target if a in present)
+        return kept if kept else None
+
+    return tuple((name, project(target)) for name, target in rules)

@@ -79,6 +79,14 @@ class TransformerLM(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0
     seq_axis: Any = None
+    # Mixture-of-Experts (expert-parallel tier, models/moe.py): 0 = dense.
+    # With N experts, every ``moe_every``-th block's FFN is an MoE layer
+    # (interleaved, GShard-style); experts shard over the mesh's
+    # ``expert`` axis under the GSPMD engine.
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -122,15 +130,31 @@ class TransformerLM(nn.Module):
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
         for i in range(depth):
-            x = DecoderBlock(
-                heads,
-                mlp_dim,
-                self.dtype,
-                self.attn_impl,
-                self.dropout,
-                seq_axis=self.seq_axis,
-                name=f"block{i}",
-            )(x, train)
+            if self.moe_experts and i % self.moe_every == self.moe_every - 1:
+                from distributeddeeplearning_tpu.models.moe import MoEDecoderBlock
+
+                x = MoEDecoderBlock(
+                    heads,
+                    mlp_dim,
+                    self.moe_experts,
+                    self.moe_top_k,
+                    self.moe_capacity_factor,
+                    dtype=self.dtype,
+                    attn_impl=self.attn_impl,
+                    dropout=self.dropout,
+                    seq_axis=self.seq_axis,
+                    name=f"block{i}",
+                )(x, train)
+            else:
+                x = DecoderBlock(
+                    heads,
+                    mlp_dim,
+                    self.dtype,
+                    self.attn_impl,
+                    self.dropout,
+                    seq_axis=self.seq_axis,
+                    name=f"block{i}",
+                )(x, train)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Tied output projection (standard LM practice; halves embedding

@@ -9,10 +9,13 @@ onto ICI within a slice and DCN across slices, so mesh axis *order*
 determines which links a collective rides (SURVEY.md §2a).
 
 Axis convention (outer → inner):
-  ``("replica", "data", "model", "seq")`` — any subset may be present.
+  ``("replica", "data", "model", "seq", "expert", "pipe")`` — any subset
+  may be present.
   * ``data``  — batch sharding (the reference's only axis, §2b)
   * ``model`` — tensor parallelism (ViT path)
   * ``seq``   — sequence/context parallelism (ring attention)
+  * ``expert`` — expert parallelism (MoE, models/moe.py)
+  * ``pipe``  — pipeline parallelism (parallel/pipeline.py)
   * ``replica`` — pure replication / multi-slice DCN axis
 For multi-slice topologies put the slower axis (DCN) outermost so
 data-parallel gradient reduction rides ICI within a slice first.
@@ -33,7 +36,11 @@ REPLICA_AXIS = "replica"
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
-CANONICAL_AXES = (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
+CANONICAL_AXES = (
+    REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS, PIPE_AXIS
+)
 
 
 @dataclasses.dataclass(frozen=True)

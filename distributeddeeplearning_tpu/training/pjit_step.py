@@ -54,6 +54,7 @@ from distributeddeeplearning_tpu.training.train_step import (
     Batch,
     cross_entropy_loss,
     l2_kernel_penalty,
+    sown_aux_loss,
 )
 
 PyTree = Any
@@ -76,7 +77,13 @@ def logical_shardings(
     Reads ``nn.with_logical_partitioning`` annotations off an abstract
     init; unannotated params (ResNet et al.) come back fully replicated.
     """
+    from distributeddeeplearning_tpu.models.sharding import rules_for_mesh
+
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # Project the rules onto THIS mesh: a rule targeting an absent mesh
+    # axis (e.g. "expert" on a plain data mesh) degrades to replicated
+    # instead of erroring — one table serves every topology.
+    rules = rules_for_mesh(mesh, tuple(rules))
     # input_dtype=None -> float32 (jnp.zeros' own default)
     abstract = jax.eval_shape(
         functools.partial(model.init, train=False),
@@ -116,8 +123,13 @@ def create_sharded_train_state(
         model, mesh, rules, shape, rng, input_dtype=input_dtype
     )
 
+    from distributeddeeplearning_tpu.models.sharding import rules_for_mesh
+
+    active_rules = list(rules_for_mesh(mesh, tuple(rules)))
+
     def init_fn(r):
-        variables = model.init(r, jnp.zeros(shape, input_dtype), train=False)
+        with nn.logical_axis_rules(active_rules):
+            variables = model.init(r, jnp.zeros(shape, input_dtype), train=False)
         params = lax.with_sharding_constraint(
             nn.unbox(variables["params"]), param_shardings
         )
@@ -172,9 +184,15 @@ def make_pjit_train_step(
     """Compiled GSPMD train step. Shardings ride in on the arguments
     (committed state + batch), so the same function serves DP, TP and
     DP×TP meshes."""
+    from distributeddeeplearning_tpu.models.sharding import (
+        LOGICAL_RULES,
+        rules_for_mesh,
+    )
+
     cfg = config or TrainConfig()
     base_rng = jax.random.PRNGKey(cfg.seed)
     batch_sharding = _mesh_batch_sharding(mesh)
+    rules = list(rules_for_mesh(mesh, LOGICAL_RULES))
 
     def step(state: TrainState, batch: Batch):
         images, labels = batch
@@ -185,15 +203,20 @@ def make_pjit_train_step(
         dropout_rng = jax.random.fold_in(base_rng, state.step)
 
         def loss_fn(params):
-            logits, mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": dropout_rng},
-            )
+            # The rules context makes in-model nn.with_logical_constraint
+            # calls real (MoE's expert-major activation layout — the
+            # all-to-all boundary); without it they are silent no-ops.
+            with mesh, nn.logical_axis_rules(rules):
+                logits, mutated = model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images,
+                    train=True,
+                    mutable=["batch_stats", "losses"],
+                    rngs={"dropout": dropout_rng},
+                )
             loss = cross_entropy_loss(logits, labels, cfg.label_smoothing)
             loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+            loss = loss + sown_aux_loss(mutated)
             return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_bs)), grads = jax.value_and_grad(
@@ -226,20 +249,26 @@ def make_pjit_eval_step(
     accepts ``(images, labels[, weights])``, returns weighted batch means
     plus the real-sample ``count`` — with GSPMD the weighted sums are
     plain global reductions, no explicit psum needed."""
+    from distributeddeeplearning_tpu.models.sharding import (
+        LOGICAL_RULES,
+        rules_for_mesh,
+    )
     from distributeddeeplearning_tpu.training.train_step import eval_metrics_fn
 
     batch_sharding = _mesh_batch_sharding(mesh)
+    rules = list(rules_for_mesh(mesh, LOGICAL_RULES))
 
     def eval_step(state: TrainState, batch):
         images, labels, weights = batch
         images = lax.with_sharding_constraint(images, batch_sharding)
         labels = lax.with_sharding_constraint(labels, batch_sharding)
         weights = lax.with_sharding_constraint(weights, batch_sharding)
-        logits = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images,
-            train=False,
-        )
+        with mesh, nn.logical_axis_rules(rules):
+            logits = model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                images,
+                train=False,
+            )
         sums = eval_metrics_fn(logits, labels, weights)
         count = sums.pop("count")
         safe = jnp.maximum(count, 1.0)

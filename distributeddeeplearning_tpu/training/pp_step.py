@@ -1,0 +1,359 @@
+"""Pipeline-parallel training — GPipe fill-drain over a ``pipe`` mesh axis.
+
+The schedule the reference never had (sync-DP only): each device holds
+ONE stage's weights (``models/pipeline_lm.py`` stacks per-stage params on
+a leading ``[S, ...]`` axis sharded over ``pipe``), and microbatches flow
+through stages over ICI ``ppermute``:
+
+* tick loop = ``lax.scan`` over ``M + S - 1`` ticks (M microbatches,
+  S stages). At tick *i*, stage *s* processes microbatch *i − s*; the
+  ramp-up/ramp-down ticks compute garbage that is masked out of the loss
+  (``jnp.where`` on the schedule validity), so every device runs the
+  identical program every tick — SPMD-uniform, no data-dependent control
+  flow, exactly what XLA wants.
+* activations hop stage→stage+1 with a single ``ppermute`` per tick —
+  the neighbor-only transfer rides one ICI link; there is no all-to-all.
+* the bubble fraction is ``(S−1)/(M+S−1)``: pick ``M ≫ S``.
+* backward is just ``jax.grad`` through the scan: AD transposes
+  ``ppermute`` into the reverse hop, giving the standard backward
+  pipeline without hand-written schedule code.
+* embedding lives on stage 0, the LM head on the last stage; their
+  parameters are replicated over the mesh but only the owning stage's
+  compute reaches the loss, so their grads are zero elsewhere — one
+  ``psum`` over ``pipe`` makes them exact and replicated again.
+
+Composes with data parallelism: on a ``(data, pipe)`` mesh the batch is
+sharded over ``data`` and gradients are ``pmean``-reduced over ``data``
+only (stage weights are *different* per pipe slot — never reduced over
+``pipe``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.models.pipeline_lm import PipelineLM
+from distributeddeeplearning_tpu.training.state import TrainState
+from distributeddeeplearning_tpu.training.train_step import (
+    cross_entropy_loss,
+    eval_metrics_fn,
+    flat_axis_index,
+    l2_kernel_penalty,
+)
+
+PyTree = Any
+Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (tokens [B,T], labels [B,T])
+
+PIPE_AXIS = "pipe"
+
+
+def _is_stages_path(path) -> bool:
+    for k in path:
+        if getattr(k, "key", None) == "stages" or getattr(k, "name", None) == "stages":
+            return True
+    return False
+
+
+def pp_state_specs(state: TrainState, pipe_axis: str = PIPE_AXIS) -> TrainState:
+    """PartitionSpec tree for a PP TrainState: everything under a
+    ``stages`` key (params AND the optimizer moments mirroring them) is
+    sharded on its leading stage axis over ``pipe``; the rest replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: P(pipe_axis) if _is_stages_path(path) else P(),
+        state,
+    )
+
+
+def create_pp_state(
+    pl: PipelineLM,
+    config: TrainConfig,
+    tx,
+    mesh: Mesh,
+    seq_len: int,
+    rng: Optional[jax.Array] = None,
+) -> TrainState:
+    """Seeded host init placed onto the mesh with per-stage sharding."""
+    if mesh.shape.get(PIPE_AXIS) != pl.num_stages:
+        raise ValueError(
+            f"mesh pipe axis {mesh.shape.get(PIPE_AXIS)} != num_stages "
+            f"{pl.num_stages}"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+    params = pl.init(rng, seq_len)
+    state = TrainState.create(params=params, batch_stats={}, tx=tx)
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), pp_state_specs(state)
+    )
+    return jax.device_put(state, shardings)
+
+
+def _data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("replica", "data") if a in mesh.axis_names)
+
+
+def make_pp_train_step(
+    pl: PipelineLM,
+    tx,
+    mesh: Mesh,
+    config: Optional[TrainConfig] = None,
+    *,
+    num_microbatches: int = 4,
+    donate_state: bool = True,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Compiled PP (×DP) train step over a mesh with a ``pipe`` axis."""
+    cfg = config or TrainConfig()
+    if PIPE_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no '{PIPE_AXIS}' axis")
+    n_stages = mesh.shape[PIPE_AXIS]
+    if n_stages != pl.num_stages:
+        raise ValueError(
+            f"mesh pipe={n_stages} != model num_stages={pl.num_stages}"
+        )
+    data_axes = _data_axes(mesh)
+    d_axis = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)
+    all_axes = tuple(data_axes) + (PIPE_AXIS,)
+    M = num_microbatches
+    embed, core, head = pl.modules()
+    base_rng = jax.random.PRNGKey(cfg.seed)
+    S = n_stages
+
+    def pipeline_logits(params, tokens, train, dropout_rng):
+        """The schedule: [b_l, T] local tokens → [b_l, T, V] logits
+        (real only on the last stage; garbage elsewhere, masked by the
+        caller)."""
+        b_l, t = tokens.shape
+        if b_l % M:
+            raise ValueError(
+                f"local batch {b_l} not divisible by {M} microbatches"
+            )
+        mb = b_l // M
+        s_idx = lax.axis_index(PIPE_AXIS)
+        x_all = embed.apply({"params": params["embed"]}, tokens)
+        hidden = x_all.shape[-1]
+        xm = x_all.reshape(M, mb, t, hidden)
+        stage_p = jax.tree.map(lambda a: a[0], params["stages"])
+
+        def tick(carry, i):
+            buf, outs = carry
+            inject = lax.dynamic_index_in_dim(
+                xm, jnp.clip(i, 0, M - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(s_idx == 0, inject, buf)
+            rngs = {"dropout": jax.random.fold_in(dropout_rng, i)} if train else None
+            y = core.apply({"params": stage_p}, x_in, train=train, rngs=rngs)
+            # Last stage finished microbatch i-(S-1) this tick.
+            m_idx = i - (S - 1)
+            valid = (m_idx >= 0) & (m_idx < M) & (s_idx == S - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(m_idx, 0, M - 1), 0
+            )
+            outs = jnp.where(valid, upd, outs)
+            if S > 1:
+                buf = lax.ppermute(
+                    y, PIPE_AXIS, [(j, j + 1) for j in range(S - 1)]
+                )
+            return (buf, outs), None
+
+        # carry starts device-varying (the tick body's outputs are), so
+        # the zero initializers must be pcast to match
+        zeros = lax.pcast(
+            jnp.zeros((mb, t, hidden), x_all.dtype), all_axes, to="varying"
+        )
+        outs0 = lax.pcast(
+            jnp.zeros((M, mb, t, hidden), x_all.dtype), all_axes, to="varying"
+        )
+        (_, outs), _ = lax.scan(tick, (zeros, outs0), jnp.arange(M + S - 1))
+        h = outs.reshape(b_l, t, hidden)
+        return head.apply({"params": params["head"]}, h)
+
+    def local_step(state: TrainState, batch: Batch):
+        tokens, labels = batch
+        s_idx = lax.axis_index(PIPE_AXIS)
+        is_last = s_idx == S - 1
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            flat_axis_index(mesh, all_axes),
+        )
+
+        # Replicated groups become device-varying so their grads stay
+        # per-device until OUR collectives (same rationale as
+        # train_step.py's pcast); stage params already vary over pipe but
+        # not over data.
+        def vary(tree, axes):
+            if not axes:
+                return tree
+            ax = axes if len(axes) > 1 else axes[0]
+            return jax.tree.map(lambda p: lax.pcast(p, ax, to="varying"), tree)
+
+        params_v = {
+            "embed": vary(state.params["embed"], all_axes),
+            "stages": vary(state.params["stages"], data_axes),
+            "head": vary(state.params["head"], all_axes),
+        }
+
+        def loss_fn(params):
+            logits = pipeline_logits(params, tokens, True, dropout_rng)
+            ce_local = cross_entropy_loss(logits, labels, cfg.label_smoothing)
+            # Only the last stage's logits are real; psum over pipe turns
+            # the masked scalar into the exact (pipe-invariant) loss.
+            ce = lax.psum(jnp.where(is_last, ce_local, 0.0), PIPE_AXIS)
+            # L2: stage kernels are per-device (psum = total); embed/head
+            # are replicated, so their term is masked to stage 0 before
+            # the psum — otherwise each of the S devices would contribute
+            # an L2 gradient and the psum'd grad would be S× too big.
+            l2_eh = l2_kernel_penalty(
+                {"embed": params["embed"], "head": params["head"]},
+                cfg.weight_decay,
+            )
+            l2 = lax.psum(
+                jnp.where(s_idx == 0, l2_eh, 0.0)
+                + l2_kernel_penalty(params["stages"], cfg.weight_decay),
+                PIPE_AXIS,
+            )
+            return ce + l2, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params_v
+        )
+        # Embed/head: contributions live on one stage, zeros elsewhere —
+        # psum over pipe restores the exact replicated grad. Stage grads
+        # are per-stage by construction (never reduced over pipe).
+        grads = {
+            "embed": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), grads["embed"]
+            ),
+            "stages": grads["stages"],
+            "head": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), grads["head"]
+            ),
+        }
+        if d_axis is not None:  # DP reduction over the data axis only
+            grads = lax.pmean(grads, d_axis)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+
+        acc_local = jnp.mean(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        )
+        accuracy = lax.psum(jnp.where(is_last, acc_local, 0.0), PIPE_AXIS)
+
+        def sq(tree):
+            return sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(tree)
+            )
+
+        gn2 = sq(grads["embed"]) + sq(grads["head"]) + lax.psum(
+            sq(grads["stages"]), PIPE_AXIS
+        )
+        metrics = {
+            "loss": loss,
+            "accuracy": accuracy,
+            "grad_norm": jnp.sqrt(gn2),
+        }
+        if d_axis is not None:
+            metrics = lax.pmean(metrics, d_axis)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=state.batch_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    def build(state: TrainState):
+        specs = pp_state_specs(state)
+        batch_spec = P(d_axis) if d_axis is not None else P()
+        return jax.jit(
+            jax.shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=(specs, (batch_spec, batch_spec)),
+                out_specs=(specs, P()),
+            ),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    _cache = {}
+
+    def step(state: TrainState, batch: Batch):
+        key = jax.tree_util.tree_structure(state)
+        if key not in _cache:
+            _cache[key] = build(state)
+        return _cache[key](state, batch)
+
+    return step
+
+
+def make_pp_eval_step(
+    pl: PipelineLM, mesh: Mesh
+) -> Callable[[TrainState, Any], Dict[str, jnp.ndarray]]:
+    """Eval through the pipeline: same exact-coverage weighted-metric
+    contract as the other engines (weights mask padded samples)."""
+    if PIPE_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no '{PIPE_AXIS}' axis")
+    S = mesh.shape[PIPE_AXIS]
+    data_axes = _data_axes(mesh)
+    d_axis = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)
+    embed, core, head = pl.modules()
+
+    def local_eval(state: TrainState, batch):
+        tokens, labels, weights = batch
+        s_idx = lax.axis_index(PIPE_AXIS)
+        b_l, t = tokens.shape
+        x = embed.apply({"params": state.params["embed"]}, tokens)
+        stage_p = jax.tree.map(lambda a: a[0], state.params["stages"])
+        # Eval runs the stages as a plain S-hop relay (one "microbatch" =
+        # the whole local batch): S ticks, each followed by a hop.
+        for i in range(S):
+            y = core.apply({"params": stage_p}, x, train=False)
+            if S > 1:
+                x = lax.ppermute(y, PIPE_AXIS, [(j, j + 1) for j in range(S - 1)])
+            else:
+                x = y
+        logits = head.apply({"params": state.params["head"]}, y)
+        sums = eval_metrics_fn(logits, labels, weights)
+        sums = jax.tree.map(
+            lambda v: jnp.where(s_idx == S - 1, v, 0.0), sums
+        )
+        sums = lax.psum(sums, PIPE_AXIS)
+        if d_axis is not None:
+            sums = lax.psum(sums, d_axis)
+        count = sums.pop("count")
+        safe = jnp.maximum(count, 1.0)
+        out = {k: v / safe for k, v in sums.items()}
+        out["count"] = count
+        return out
+
+    def build(state: TrainState):
+        specs = pp_state_specs(state)
+        batch_spec = P(d_axis) if d_axis is not None else P()
+        return jax.jit(
+            jax.shard_map(
+                local_eval,
+                mesh=mesh,
+                in_specs=(specs, (batch_spec, batch_spec, batch_spec)),
+                out_specs=P(),
+            )
+        )
+
+    _cache = {}
+
+    def step(state: TrainState, batch):
+        if len(batch) == 2:
+            tokens, labels = batch
+            weights = jnp.ones(labels.shape[:1], jnp.float32)
+            batch = (tokens, labels, weights)
+        key = jax.tree_util.tree_structure(state)
+        if key not in _cache:
+            _cache[key] = build(state)
+        return _cache[key](state, batch)
+
+    return step

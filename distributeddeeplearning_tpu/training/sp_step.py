@@ -38,6 +38,7 @@ from distributeddeeplearning_tpu.training.train_step import (
     cross_entropy_loss,
     flat_axis_index,
     l2_kernel_penalty,
+    sown_aux_loss,
 )
 
 Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (tokens [B,T], labels [B,T])
@@ -96,10 +97,11 @@ def make_sp_train_step(
         )
 
         def loss_fn(params):
-            logits = model.apply(
+            logits, mutated = model.apply(
                 {"params": params},
                 tokens,
                 train=True,
+                mutable=["losses"],
                 rngs={"dropout": dropout_rng},
             )
             # Local mean over the shard's tokens; pmean over equal-sized
@@ -107,6 +109,7 @@ def make_sp_train_step(
             loss = cross_entropy_loss(logits, labels, cfg.label_smoothing)
             # Same objective as the DP/pjit engines (train_step.py:205).
             loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+            loss = loss + sown_aux_loss(mutated)
             return loss, logits
 
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_v)

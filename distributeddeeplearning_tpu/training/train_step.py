@@ -78,6 +78,17 @@ def cross_entropy_loss(
     return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
 
 
+def sown_aux_loss(mutated: PyTree) -> jnp.ndarray:
+    """Sum of everything the model sowed into the ``"losses"`` collection
+    (e.g. the MoE load-balance loss, ``models/moe.py``). Zero for models
+    that sow nothing — every engine adds this term unconditionally."""
+    leaves = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.asarray(leaf, jnp.float32)
+    return total
+
+
 def l2_kernel_penalty(params: PyTree, weight_decay: float) -> jnp.ndarray:
     """L2 on conv/dense kernels only — parity with the Keras path's
     injected ``l2(5e-5)`` kernel regularizer (``imagenet_keras_horovod.py:
@@ -203,11 +214,12 @@ def make_train_step(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
                 train=True,
-                mutable=["batch_stats"],
+                mutable=["batch_stats", "losses"],
                 rngs={"dropout": dropout_rng},
             )
             loss = cross_entropy_loss(logits, labels, cfg.label_smoothing)
             loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+            loss = loss + sown_aux_loss(mutated)
             return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -30,12 +30,7 @@ def main():
     logger = get_logger()
     logger.info("explicit-loop training: %s", config)
 
-    model = get_model(
-        config.model,
-        num_classes=config.num_classes,
-        dtype=config.compute_dtype,
-        attn_impl=config.attn_impl,
-    )
+    model = get_model(config.model, **config.model_kwargs())
     train_data = make_dataset(config, train=True)
     pieces, state = explicit.setup(
         model, config, steps_per_epoch=train_data.steps_per_epoch

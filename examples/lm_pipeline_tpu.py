@@ -1,0 +1,123 @@
+"""Pipeline-parallel LM training on synthetic tokens.
+
+The PP tier end-to-end: a decoder LM split into stages over a ``pipe``
+mesh axis (each device holds one stage's weights), trained with the
+GPipe fill-drain schedule (``training/pp_step.py``), composed with data
+parallelism when the mesh has a ``data`` axis.
+
+Env contract (the usual reference-style knobs plus PP's own)::
+
+    PP_STAGES=4 PP_MICROBATCHES=8 MESH_SHAPE=2,4 \
+    FAKE_DATA_LENGTH=4096 EPOCHS=1 BATCHSIZE=4 SEQ_LEN=128 \
+    python examples/lm_pipeline_tpu.py
+
+``MESH_SHAPE`` here is ``(data, pipe)``; it defaults to all devices on
+``pipe``. Smoke (CPU): prefix with
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.synthetic import SyntheticTokenDataset
+from distributeddeeplearning_tpu.models.pipeline_lm import PipelineLM
+from distributeddeeplearning_tpu.parallel import distributed
+from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+from distributeddeeplearning_tpu.training.pp_step import (
+    create_pp_state,
+    make_pp_eval_step,
+    make_pp_train_step,
+)
+from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
+from distributeddeeplearning_tpu.utils.timer import Timer
+
+
+def main():
+    distributed.maybe_initialize()
+    seq_len = int(os.environ.get("SEQ_LEN", "128"))
+    vocab = int(os.environ.get("VOCAB_SIZE", "1024"))
+    stages = int(os.environ.get("PP_STAGES", "0")) or len(jax.devices())
+    microbatches = int(os.environ.get("PP_MICROBATCHES", "4"))
+    config = TrainConfig.from_env(num_classes=vocab, model="lm_tiny")
+    logger = get_logger()
+
+    n_dev = len(jax.devices())
+    if config.mesh_shape is not None:
+        data_par, stages = config.mesh_shape
+    else:
+        data_par = n_dev // stages
+    mesh = create_mesh(axes=("data", "pipe"), shape=(data_par, stages))
+    from distributeddeeplearning_tpu.models.transformer_lm import _VARIANTS
+
+    variant = config.model.replace("lm_", "")
+    if variant not in _VARIANTS:
+        raise SystemExit(
+            f"MODEL={config.model!r}: the pipeline example supports the dense "
+            f"LM family only (lm_{{{','.join(sorted(_VARIANTS))}}})"
+        )
+    depth = _VARIANTS[variant][1]
+    # round the depth up to a stage multiple so every stage is equal
+    n_layers = -(-depth // stages) * stages
+    pl = PipelineLM(
+        variant=variant, vocab_size=vocab, max_seq_len=seq_len,
+        num_stages=stages, n_layers=n_layers,
+    )
+    logger.info(
+        "PP LM: %s over %d stages x %d-way DP, %d microbatches",
+        variant, stages, data_par, microbatches,
+    )
+
+    data = SyntheticTokenDataset(
+        length=config.fake_data_length,
+        global_batch_size=config.batch_size_per_device * data_par,
+        seq_len=seq_len,
+        vocab_size=vocab,
+        seed=config.seed,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    tx = optax.sgd(config.base_lr * data_par, momentum=config.momentum)
+    state = create_pp_state(pl, config, tx, mesh, seq_len)
+    step = make_pp_train_step(
+        pl, tx, mesh, config, num_microbatches=microbatches
+    )
+    spec = NamedSharding(mesh, P("data"))
+
+    timer = Timer().start()
+    seen = 0
+    metrics = {}
+    for epoch in range(config.epochs):
+        for tokens, labels in data.epoch(epoch):
+            batch = (jax.device_put(tokens, spec), jax.device_put(labels, spec))
+            state, metrics = step(state, batch)
+            seen += tokens.shape[0]
+    jax.block_until_ready(metrics)
+    timer.stop()
+    logger.info(
+        "final loss %.4f acc %.4f", float(metrics.get("loss", np.nan)),
+        float(metrics.get("accuracy", np.nan)),
+    )
+    log_summary(
+        data_length=seen,
+        duration_s=timer.elapsed,
+        batch_size_per_device=config.batch_size_per_device,
+        num_devices=n_dev,
+        dataset_kind="synthetic-tokens",
+    )
+    eval_step = make_pp_eval_step(pl, mesh)
+    rows = next(iter(data.epoch(0)))
+    m = eval_step(
+        state, (jax.device_put(rows[0], spec), jax.device_put(rows[1], spec))
+    )
+    logger.info("eval: loss %.4f top1 %.4f", float(m["loss"]), float(m["top1"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,9 +44,7 @@ def main():
 
     model = get_model(
         config.model,
-        num_classes=vocab,
-        dtype=config.compute_dtype,
-        attn_impl=config.attn_impl,
+        **{**config.model_kwargs(), "num_classes": vocab},
         max_seq_len=seq_len,
     )
     data = SyntheticTokenDataset(

@@ -269,13 +269,18 @@ def test_engine_validation_and_config_mesh(devices):
     )
     use_pjit, mesh = resolve_engine(cfg)
     assert use_pjit and mesh.shape == {"data": 2, "model": 4}
-    # annotated model on a mesh without a 'model' axis: clear guidance
+    # annotated model on a mesh without a 'model' axis: the rules project
+    # onto the mesh (models/sharding.rules_for_mesh) — params degrade to
+    # replicated and the run is plain DP, not an error. One rules table
+    # serves every topology (model / expert / pipe axes optional).
     from distributeddeeplearning_tpu.training.pjit_step import build_pjit_state
 
     dp_cfg = CFG.replace(engine="pjit")  # no mesh_shape -> pure-data mesh
     _, dp_mesh = resolve_engine(dp_cfg)
-    with pytest.raises(ValueError, match="MESH_AXES=data,model"):
-        build_pjit_state(_vit(), dp_cfg, optax.sgd(0.1), dp_mesh)
+    state = build_pjit_state(_vit(), dp_cfg, optax.sgd(0.1), dp_mesh)
+    qkv = state.params["block0"]["attn"]["qkv"]["kernel"]
+    # replicated, not sharded
+    assert all(p is None for p in tuple(qkv.sharding.spec))
 
 
 def test_estimator_frontend_with_pjit_engine(tp_mesh):
