@@ -78,6 +78,10 @@ class TrainConfig:
     fake_data_length: int = IMAGENET_TRAIN_LENGTH
     data_dir: Optional[str] = None
     val_data_dir: Optional[str] = None
+    # Real-data pipeline: "auto" detects TFRecord shards vs an ImageFolder
+    # tree; force with "imagefolder" | "tfrecord" (tf.data reader) |
+    # "tfrecord-native" (first-party TF-free reader, native/ tier).
+    data_format: str = "auto"
     validation: bool = False
     num_workers: int = 4  # Keras NUM_WORKERS (:44-46)
     prefetch_batches: int = 2
@@ -157,6 +161,8 @@ class TrainConfig:
             kw["attn_impl"] = e["ATTN_IMPL"]
         if "MOE_EXPERTS" in e:
             kw["moe_experts"] = int(e["MOE_EXPERTS"])
+        if "DATA_FORMAT" in e:
+            kw["data_format"] = e["DATA_FORMAT"]
         if "ENGINE" in e:
             kw["engine"] = e["ENGINE"]
         # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)

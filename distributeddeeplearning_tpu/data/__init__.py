@@ -42,10 +42,9 @@ def make_dataset(config, train: bool = True):
             exact=not train,
             dtype=dtype,
         )
-    from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
-
-    return ImageFolderDataset(
-        config.data_dir if train else config.val_data_dir,
+    root = config.data_dir if train else config.val_data_dir
+    fmt = _resolve_data_format(config, root)
+    common = dict(
         global_batch_size=config.global_batch_size,
         image_size=config.image_size,
         train=train,
@@ -55,6 +54,94 @@ def make_dataset(config, train: bool = True):
         process_count=jax.process_count(),
         image_dtype=dtype,
     )
+    if fmt == "imagefolder":
+        from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
+
+        return ImageFolderDataset(root, **common)
+    pattern = _tfrecord_pattern(root)
+    if fmt == "tfrecord-native":
+        from distributeddeeplearning_tpu.data.imagenet import (
+            NativeTFRecordImageNetDataset,
+        )
+
+        return NativeTFRecordImageNetDataset(pattern, **common)
+    from distributeddeeplearning_tpu.data.imagenet import TFRecordImageNetDataset
+
+    common.pop("num_workers")  # tf.data autotunes its own parallelism
+    return TFRecordImageNetDataset(pattern, **common)
+
+
+_TFRECORD_SUFFIXES = (".tfrecord", ".tfrecords")
+
+
+def _tfrecord_pattern(root: str) -> str:
+    """A concrete path/glob for the TFRecord readers: pass globs through,
+    expand directories to their shard files (prepare.py's
+    ``{prefix}-NNNNN-of-NNNNN`` naming or ``*.tfrecord``)."""
+    import glob
+    import os
+
+    if any(ch in root for ch in "*?["):
+        return root
+    if os.path.isdir(root):
+        for pat in ("*-of-*", "*.tfrecord", "*.tfrecords"):
+            if glob.glob(os.path.join(root, pat)):
+                return os.path.join(root, pat)
+    return root
+
+
+def _resolve_data_format(config, root: str) -> str:
+    """``config.data_format``, with "auto" sniffing the layout: TFRecord
+    shards (a glob, or a dir containing shard-named files) vs an
+    ImageFolder class tree. The tf.data reader is preferred when
+    TensorFlow imports; otherwise the native TF-free reader."""
+    fmt = config.data_format
+    if fmt not in ("auto", "imagefolder", "tfrecord", "tfrecord-native"):
+        raise ValueError(
+            f"unknown data_format {fmt!r}; use auto | imagefolder | "
+            "tfrecord | tfrecord-native"
+        )
+    if fmt == "imagefolder":
+        return fmt
+    if fmt == "auto":
+        import os
+        import re
+
+        looks_tfrecord = (
+            _tfrecord_pattern(root) != root
+            or any(ch in root for ch in "*?[")
+            or (
+                not os.path.isdir(root)
+                and (
+                    root.endswith(_TFRECORD_SUFFIXES)
+                    # prepare.py's shard naming, e.g. imagenet-00000-of-01024
+                    or re.search(r"-\d+-of-\d+$", root) is not None
+                )
+            )
+        )
+        if not looks_tfrecord:
+            return "imagefolder"
+        # auto prefers the tf.data reader, falling back to the TF-free
+        # native reader when TensorFlow is absent.
+        try:
+            import tensorflow  # noqa: F401
+
+            return "tfrecord"
+        except ImportError:
+            return "tfrecord-native"
+    if fmt == "tfrecord":
+        # Explicitly forced tf.data reader: do NOT silently substitute the
+        # native reader (its JPEG decode differs from TF's by a few
+        # counts/pixel) — fail loudly instead.
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "data_format='tfrecord' forces the tf.data reader but "
+                "TensorFlow is not importable; use "
+                "data_format='tfrecord-native' (TF-free) or 'auto'"
+            ) from e
+    return fmt
 
 
 def make_input_fn(train: bool = True):

@@ -108,21 +108,90 @@ def _center_crop_resize(img, size: int):
     return img.resize((size, size), Image.BILINEAR, box=(x, y, x + crop, y + crop))
 
 
+def _transform_pil(img, size: int, train: bool, rng: np.random.Generator) -> np.ndarray:
+    """Augment/normalize an open PIL image (shared by the path-based and
+    TFRecord-payload decoders)."""
+    from PIL import Image
+
+    img = img.convert("RGB")
+    if train:
+        img = _random_resized_crop(img, size, rng)
+        if rng.random() < 0.5:
+            img = img.transpose(Image.FLIP_LEFT_RIGHT)
+    else:
+        img = _center_crop_resize(img, size)
+    arr = np.asarray(img, np.float32) / 255.0
+    return (arr - _MEAN) / _SD
+
+
 def _load_image(
     path: str, size: int, train: bool, rng: np.random.Generator
 ) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as img:
-        img = img.convert("RGB")
-        if train:
-            img = _random_resized_crop(img, size, rng)
-            if rng.random() < 0.5:
-                img = img.transpose(Image.FLIP_LEFT_RIGHT)
-        else:
-            img = _center_crop_resize(img, size)
-        arr = np.asarray(img, np.float32) / 255.0
-    return (arr - _MEAN) / _SD
+        return _transform_pil(img, size, train, rng)
+
+
+def _threaded_epoch_batches(
+    *,
+    n_records: int,
+    train: bool,
+    seed: int,
+    epoch_index: int,
+    process_index: int,
+    process_count: int,
+    local_batch_size: int,
+    steps_per_epoch: int,
+    num_workers: int,
+    decode,
+):
+    """Shared epoch driver for the PIL-decoding datasets (ImageFolder and
+    native TFRecord): the same permutation on every process (seeded by
+    epoch, like ``DistributedSampler.set_epoch``, reference ``:353-354``),
+    a disjoint round-robin slice per process, modulo-wrap for train, and
+    pad+mask (absolute record 0 as the dummy) for exact-coverage eval.
+
+    ``decode(record_index, epoch_index) -> (image, label)`` supplies the
+    storage-specific read+augment.
+    """
+    order = np.arange(n_records)
+    if train:
+        np.random.RandomState((seed + epoch_index) % (2**31 - 1)).shuffle(order)
+    local = order[process_index::process_count]
+    if train and len(local) == 0:
+        raise ValueError(
+            f"process {process_index}/{process_count} owns none of the "
+            f"{n_records} records — reduce process_count or add data"
+        )
+    b = local_batch_size
+
+    def call(ridx):
+        return decode(int(ridx), epoch_index)
+
+    with concurrent.futures.ThreadPoolExecutor(max(num_workers, 1)) as pool:
+        for step in range(steps_per_epoch):
+            if train:
+                idxs = [local[(step * b + j) % len(local)] for j in range(b)]
+                results = list(pool.map(call, idxs))
+                yield (
+                    np.stack([r[0] for r in results]),
+                    np.asarray([r[1] for r in results], np.int32),
+                )
+            else:
+                # Eval: slots past this process's share are zero-weight
+                # padding (decode absolute record 0 as a dummy).
+                slots = np.arange(step * b, step * b + b)
+                weights = (slots < len(local)).astype(np.float32)
+                idxs = [
+                    local[s] if s < len(local) else 0 for s in slots
+                ]
+                results = list(pool.map(call, idxs))
+                yield (
+                    np.stack([r[0] for r in results]),
+                    np.asarray([r[1] for r in results], np.int32),
+                    weights,
+                )
 
 
 class ImageFolderDataset:
@@ -168,53 +237,30 @@ class ImageFolderDataset:
     def __len__(self) -> int:
         return len(self.samples)
 
+    def _decode_sample(self, sample_idx: int, epoch_index: int):
+        path, label = self.samples[sample_idx]
+        rng = np.random.default_rng(
+            (self.seed, epoch_index, int(sample_idx), self.process_index)
+        )
+        img = _load_image(path, self.image_size, self.train, rng)
+        # Cast per-image inside the pool: stack() in the driver then
+        # builds the batch directly at the staging dtype (bf16 = half the
+        # allocation), instead of a serial full-batch astype.
+        return img.astype(self.image_dtype, copy=False), label
+
     def epoch(self, epoch_index: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        # Same permutation on every process (seeded by epoch, like
-        # DistributedSampler.set_epoch, reference :353-354), then a
-        # disjoint round-robin slice per process.
-        order = np.arange(len(self.samples))
-        if self.train:
-            np.random.RandomState((self.seed + epoch_index) % (2**31 - 1)).shuffle(
-                order
-            )
-        local = order[self.process_index :: self.process_count]
-        b = self.local_batch_size
-
-        def decode(args):
-            i, sample_idx = args
-            path, label = self.samples[sample_idx]
-            rng = np.random.default_rng(
-                (self.seed, epoch_index, int(sample_idx), self.process_index)
-            )
-            img = _load_image(path, self.image_size, self.train, rng)
-            # Cast per-image inside the pool: stack() below then builds
-            # the batch directly at the staging dtype (bf16 = half the
-            # allocation), instead of a serial full-batch astype.
-            return img.astype(self.image_dtype, copy=False), label
-
-        with concurrent.futures.ThreadPoolExecutor(self.num_workers) as pool:
-            for step in range(self.steps_per_epoch):
-                if self.train:
-                    idxs = [
-                        (j, int(local[(step * b + j) % len(local)])) for j in range(b)
-                    ]
-                    results = list(pool.map(decode, idxs))
-                    images = np.stack([r[0] for r in results])
-                    labels = np.asarray([r[1] for r in results], np.int32)
-                    yield images, labels
-                else:
-                    # Eval: slots past this process's share are zero-weight
-                    # padding (decode sample 0 as a dummy).
-                    slots = np.arange(step * b, step * b + b)
-                    weights = (slots < len(local)).astype(np.float32)
-                    idxs = [
-                        (j, int(local[s]) if s < len(local) else 0)
-                        for j, s in enumerate(slots)
-                    ]
-                    results = list(pool.map(decode, idxs))
-                    images = np.stack([r[0] for r in results])
-                    labels = np.asarray([r[1] for r in results], np.int32)
-                    yield images, labels, weights
+        yield from _threaded_epoch_batches(
+            n_records=len(self.samples),
+            train=self.train,
+            seed=self.seed,
+            epoch_index=epoch_index,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            local_batch_size=self.local_batch_size,
+            steps_per_epoch=self.steps_per_epoch,
+            num_workers=self.num_workers,
+            decode=self._decode_sample,
+        )
 
     def __iter__(self):
         return self.epoch(0)
@@ -387,6 +433,117 @@ class TFRecordImageNetDataset:
 
     def __len__(self) -> int:
         return self.length
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+class NativeTFRecordImageNetDataset:
+    """TFRecord pipeline with **no TensorFlow dependency**.
+
+    Built on the first-party native tier: the C++ indexer
+    (``native/ddl_native.cc``) maps every shard once at construction
+    (offset+length per record, optional CRC verify), records are read by
+    seek, decoded by the hand-rolled Example codec
+    (``native/example_proto.py``), and JPEGs decode/augment on a thread
+    pool with the same transforms as :class:`ImageFolderDataset` (exact
+    same normalization constants and Inception crop).
+
+    Sharding is by *record* round-robin (like this module's tf.data eval
+    path): every record lands on exactly one process regardless of
+    uneven shard files. Train floors to ``steps_per_epoch`` full batches
+    (wrapping the local slice); eval is exact-coverage with zero-weight
+    padding. Yields the same numpy batch contract as the other datasets.
+    """
+
+    def __init__(
+        self,
+        file_pattern: str,
+        *,
+        global_batch_size: int,
+        image_size: int = 224,
+        train: bool = True,
+        seed: int = 42,
+        num_workers: int = 4,
+        process_index: int = 0,
+        process_count: int = 1,
+        image_dtype=np.float32,
+        verify: bool = False,
+    ):
+        from distributeddeeplearning_tpu.native import index_tfrecord
+
+        if global_batch_size % process_count != 0:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{process_count} processes"
+            )
+        files = sorted(globlib.glob(file_pattern))
+        if not files:
+            raise FileNotFoundError(f"no TFRecord files match {file_pattern}")
+        self.files = files
+        self.image_dtype = np.dtype(image_dtype)
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // process_count
+        self.image_size = image_size
+        self.train = train
+        self.seed = seed
+        self.num_workers = max(num_workers, 1)
+        self.process_index = process_index
+        self.process_count = process_count
+
+        file_ids, offsets, lengths = [], [], []
+        for fi, f in enumerate(files):
+            offs, lens = index_tfrecord(f, verify=verify)
+            file_ids.append(np.full(len(offs), fi, np.int32))
+            offsets.append(offs)
+            lengths.append(lens)
+        self._file_of = np.concatenate(file_ids)
+        self._offset = np.concatenate(offsets)
+        self._length = np.concatenate(lengths)
+        self.length = int(self._file_of.shape[0])
+        if self.length == 0:
+            raise FileNotFoundError(f"no records in {file_pattern}")
+        if train:
+            self.steps_per_epoch = max(self.length // global_batch_size, 1)
+        else:
+            self.steps_per_epoch = -(-self.length // global_batch_size)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def _decode_record(self, ridx: int, epoch_index: int) -> Tuple[np.ndarray, int]:
+        import io
+
+        from PIL import Image
+
+        from distributeddeeplearning_tpu.native.example_proto import parse_example
+
+        with open(self.files[self._file_of[ridx]], "rb") as f:
+            f.seek(int(self._offset[ridx]))
+            payload = f.read(int(self._length[ridx]))
+        feats = parse_example(payload)
+        encoded = feats["image/encoded"]
+        label = int(feats["image/class/label"][0])
+        rng = np.random.default_rng(
+            (self.seed, epoch_index, int(ridx), self.process_index)
+        )
+        with Image.open(io.BytesIO(encoded)) as img:
+            arr = _transform_pil(img, self.image_size, self.train, rng)
+        return arr.astype(self.image_dtype, copy=False), label
+
+    def epoch(self, epoch_index: int = 0):
+        yield from _threaded_epoch_batches(
+            n_records=self.length,
+            train=self.train,
+            seed=self.seed,
+            epoch_index=epoch_index,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            local_batch_size=self.local_batch_size,
+            steps_per_epoch=self.steps_per_epoch,
+            num_workers=self.num_workers,
+            decode=self._decode_record,
+        )
 
     def __iter__(self):
         return self.epoch(0)

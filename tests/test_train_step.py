@@ -211,3 +211,26 @@ def test_weight_decay_changes_grads(mesh8):
         s_nw, batch
     )
     assert float(m_wd["loss"]) > float(m_nw["loss"])  # L2 penalty added
+
+
+def test_replica_axis_mesh_matches_plain_dp(mesh8):
+    """Multi-slice shape: a (replica=2, data=4) mesh — replica is the
+    DCN-outer axis — computes the identical update to the flat 8-way
+    data mesh (the batch shards over replica×data and grads pmean over
+    both axes)."""
+    model = _model()
+    tx = optax.sgd(0.1, momentum=0.9)
+    images, labels = _batch()
+
+    results = []
+    for mesh in (
+        create_mesh(axes=("replica", "data"), shape=(2, 4)),
+        mesh8,
+    ):
+        state = replicate_state(create_train_state(model, CFG, tx), mesh)
+        step = make_train_step(model, tx, mesh, CFG, donate_state=False)
+        state, metrics = step(state, shard_batch((images, labels), mesh))
+        results.append((float(metrics["loss"]), jax.device_get(state.params)))
+    assert np.isclose(results[0][0], results[1][0], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(results[0][1]), jax.tree.leaves(results[1][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
