@@ -94,8 +94,133 @@ def run_bench(per_device_batch: int, devices=None, profile_dir=None):
     return images_per_sec, n_dev
 
 
+def run_lm_bench(
+    model_name: str,
+    per_device_batch: int,
+    seq_len: int,
+    attn_impl: str,
+    profile_dir=None,
+):
+    """Long-context tier protocol: tokens/sec for a decoder LM (dense or
+    MoE) on synthetic tokens, DP over all attached devices. Selected via
+    ``BENCH_MODEL=lm_small`` etc.; the default ResNet50 protocol (the
+    driver's canonical line) is untouched."""
+    import contextlib
+    import os
+
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+    from distributeddeeplearning_tpu.training import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+    from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+    vocab = int(os.environ.get("BENCH_VOCAB", "32000"))
+    n_dev = jax.device_count()
+    global_batch = per_device_batch * n_dev
+    cfg = TrainConfig(
+        model=model_name,
+        batch_size_per_device=per_device_batch,
+        attn_impl=attn_impl,
+        num_classes=vocab,
+    )
+    model = get_model(model_name, **cfg.model_kwargs(), max_seq_len=seq_len)
+    mesh = data_parallel_mesh(n_dev)
+    tx, _ = create_optimizer(cfg, steps_per_epoch=64)
+    state = replicate_state(
+        create_train_state(
+            model, cfg, tx, input_shape=(1, seq_len), input_dtype=jnp.int32
+        ),
+        mesh,
+    )
+    step = make_train_step(model, tx, mesh, cfg)
+    rng = np.random.RandomState(42)
+    rows = rng.randint(0, vocab, size=(global_batch, seq_len + 1)).astype(np.int32)
+    batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # fence (see run_bench)
+
+    prof = (
+        jax.profiler.trace(profile_dir) if profile_dir else contextlib.nullcontext()
+    )
+    with prof:
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        dt = time.perf_counter() - t0
+    tokens_per_sec = MEASURE_STEPS * global_batch * seq_len / dt
+    return tokens_per_sec, n_dev
+
+
+def lm_main():
+    import os
+
+    model_name = os.environ["BENCH_MODEL"]
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "1024"))
+    attn_impl = os.environ.get(
+        "ATTN_IMPL", "pallas" if jax.default_backend() == "tpu" else "xla"
+    )
+    batches = (8, 4, 2, 1)
+    if "BENCH_BATCH" in os.environ:
+        batches = (int(os.environ["BENCH_BATCH"]),)
+    profile_dir = os.environ.get("BENCH_PROFILE") or None
+    last_err = None
+    for per_device_batch in batches:
+        try:
+            tps, n_dev = run_lm_bench(
+                model_name, per_device_batch, seq_len, attn_impl, profile_dir
+            )
+            print(
+                json.dumps(
+                    {
+                        "metric": f"{model_name}_synthetic_train_tokens_per_sec",
+                        "value": round(tps, 1),
+                        # no reference point: the reference is vision-only
+                        "unit": "tokens/sec",
+                        "vs_baseline": 0.0,
+                        "detail": {
+                            "devices": n_dev,
+                            "per_device_batch": per_device_batch,
+                            "seq_len": seq_len,
+                            "attn_impl": attn_impl,
+                            "tokens_per_sec_per_device": round(tps / n_dev, 1),
+                            "platform": jax.devices()[0].platform,
+                        },
+                    }
+                )
+            )
+            return 0
+        except Exception as e:
+            last_err = e
+            continue
+    print(
+        json.dumps(
+            {
+                "metric": f"{model_name}_synthetic_train_tokens_per_sec",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                "error": repr(last_err),
+            }
+        )
+    )
+    return 1
+
+
 def main():
     import os
+
+    if os.environ.get("BENCH_MODEL", "").startswith("lm_"):
+        return lm_main()
 
     last_err = None
     profile_dir = os.environ.get("BENCH_PROFILE") or None

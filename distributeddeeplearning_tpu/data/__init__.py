@@ -1,3 +1,5 @@
+from typing import Optional
+
 from distributeddeeplearning_tpu.data.synthetic import (
     SyntheticImageDataset,
     SyntheticTokenDataset,
@@ -43,7 +45,8 @@ def make_dataset(config, train: bool = True):
             dtype=dtype,
         )
     root = config.data_dir if train else config.val_data_dir
-    fmt = _resolve_data_format(config, root)
+    pattern = _tfrecord_pattern(root)  # one directory scan, reused below
+    fmt = _resolve_data_format(config, root, pattern)
     common = dict(
         global_batch_size=config.global_batch_size,
         image_size=config.image_size,
@@ -58,7 +61,6 @@ def make_dataset(config, train: bool = True):
         from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
 
         return ImageFolderDataset(root, **common)
-    pattern = _tfrecord_pattern(root)
     if fmt == "tfrecord-native":
         from distributeddeeplearning_tpu.data.imagenet import (
             NativeTFRecordImageNetDataset,
@@ -90,11 +92,16 @@ def _tfrecord_pattern(root: str) -> str:
     return root
 
 
-def _resolve_data_format(config, root: str) -> str:
+def _resolve_data_format(config, root: str, pattern: Optional[str] = None) -> str:
     """``config.data_format``, with "auto" sniffing the layout: TFRecord
     shards (a glob, or a dir containing shard-named files) vs an
     ImageFolder class tree. The tf.data reader is preferred when
-    TensorFlow imports; otherwise the native TF-free reader."""
+    TensorFlow imports; otherwise the native TF-free reader.
+
+    ``pattern``: pass ``_tfrecord_pattern(root)`` if already computed so
+    the directory is only scanned once."""
+    if pattern is None:
+        pattern = _tfrecord_pattern(root)
     fmt = config.data_format
     if fmt not in ("auto", "imagefolder", "tfrecord", "tfrecord-native"):
         raise ValueError(
@@ -108,7 +115,7 @@ def _resolve_data_format(config, root: str) -> str:
         import re
 
         looks_tfrecord = (
-            _tfrecord_pattern(root) != root
+            pattern != root
             or any(ch in root for ch in "*?[")
             or (
                 not os.path.isdir(root)

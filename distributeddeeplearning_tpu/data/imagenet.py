@@ -133,6 +133,25 @@ def _load_image(
         return _transform_pil(img, size, train, rng)
 
 
+def _epoch_plan(
+    length: int, global_batch_size: int, process_count: int, train: bool
+) -> Tuple[int, int]:
+    """(local_batch_size, steps_per_epoch) — the one place the sizing
+    contract lives for every reader: global batch must divide across
+    processes; train floors to full batches; eval ceils (exact coverage
+    via pad+mask of the trailing batch)."""
+    if global_batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{process_count} processes"
+        )
+    if train:
+        steps = max(length // global_batch_size, 1)
+    else:
+        steps = -(-length // global_batch_size)
+    return global_batch_size // process_count, steps
+
+
 def _threaded_epoch_batches(
     *,
     n_records: int,
@@ -210,29 +229,19 @@ class ImageFolderDataset:
         process_count: int = 1,
         image_dtype=np.float32,
     ):
-        if global_batch_size % process_count != 0:
-            raise ValueError(
-                f"global batch {global_batch_size} not divisible by "
-                f"{process_count} processes"
-            )
         self.image_dtype = np.dtype(image_dtype)
         self.samples, self.classes = _list_samples(root)
         self.num_classes = len(self.classes)
         self.global_batch_size = global_batch_size
-        self.local_batch_size = global_batch_size // process_count
         self.image_size = image_size
         self.train = train
         self.seed = seed
         self.num_workers = max(num_workers, 1)
         self.process_index = process_index
         self.process_count = process_count
-        if train:
-            self.steps_per_epoch = max(len(self.samples) // global_batch_size, 1)
-        else:
-            # Exact full-set eval: ceil + pad-and-mask the trailing batch,
-            # so top-1/top-5 cover every image exactly once (the reference
-            # wrapped indices modulo and double-counted).
-            self.steps_per_epoch = -(-len(self.samples) // global_batch_size)
+        self.local_batch_size, self.steps_per_epoch = _epoch_plan(
+            len(self.samples), global_batch_size, process_count, train
+        )
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -297,13 +306,10 @@ class TFRecordImageNetDataset:
         files = sorted(globlib.glob(file_pattern))
         if not files:
             raise FileNotFoundError(f"no TFRecord files match {file_pattern}")
-        if global_batch_size % process_count != 0:
-            raise ValueError("global batch not divisible by process count")
         self._tf = tf
         self._tf_image_dtype = tf.dtypes.as_dtype(np.dtype(image_dtype))
         self.files = files
         self.global_batch_size = global_batch_size
-        self.local_batch_size = global_batch_size // process_count
         self.image_size = image_size
         self.train = train
         self.seed = seed
@@ -321,11 +327,9 @@ class TFRecordImageNetDataset:
 
             length = sum(count_records(f) for f in files)
         self.length = length
-        if train:
-            self.steps_per_epoch = max(length // global_batch_size, 1)
-        else:
-            # Exact full-set eval (see ImageFolderDataset): ceil + pad+mask.
-            self.steps_per_epoch = -(-length // global_batch_size)
+        self.local_batch_size, self.steps_per_epoch = _epoch_plan(
+            length, global_batch_size, process_count, train
+        )
 
     def _parse(self, record, training: bool):
         tf = self._tf
@@ -472,18 +476,12 @@ class NativeTFRecordImageNetDataset:
     ):
         from distributeddeeplearning_tpu.native import index_tfrecord
 
-        if global_batch_size % process_count != 0:
-            raise ValueError(
-                f"global batch {global_batch_size} not divisible by "
-                f"{process_count} processes"
-            )
         files = sorted(globlib.glob(file_pattern))
         if not files:
             raise FileNotFoundError(f"no TFRecord files match {file_pattern}")
         self.files = files
         self.image_dtype = np.dtype(image_dtype)
         self.global_batch_size = global_batch_size
-        self.local_batch_size = global_batch_size // process_count
         self.image_size = image_size
         self.train = train
         self.seed = seed
@@ -503,10 +501,9 @@ class NativeTFRecordImageNetDataset:
         self.length = int(self._file_of.shape[0])
         if self.length == 0:
             raise FileNotFoundError(f"no records in {file_pattern}")
-        if train:
-            self.steps_per_epoch = max(self.length // global_batch_size, 1)
-        else:
-            self.steps_per_epoch = -(-self.length // global_batch_size)
+        self.local_batch_size, self.steps_per_epoch = _epoch_plan(
+            self.length, global_batch_size, process_count, train
+        )
 
     def __len__(self) -> int:
         return self.length

@@ -113,17 +113,42 @@ def _child_env(
     return env
 
 
-def _stream(proc: subprocess.Popen, rank: int, tag: bool, sink) -> threading.Thread:
+def _stream(
+    proc: subprocess.Popen, rank: int, tag: bool, sink, heartbeat=None
+) -> threading.Thread:
     """Pump one child's merged stdout/stderr to ``sink``, rank-tagged.
 
     The log-streaming role of ``az batchai job file stream … stdout.txt``
     (``01_Train*.ipynb`` cells 25-26) and mpirun ``--tag-output``.
+    ``heartbeat``: single-element list updated with the time of the last
+    line from ANY child — the hang watchdog's signal.
     """
 
     def pump():
         prefix = f"[{rank}] " if tag else ""
-        for line in proc.stdout:  # type: ignore[union-attr]
-            sink.write(prefix + line)
+        raw = proc.stdout  # binary pipe (see launch_local's Popen)
+        pending = b""
+        while True:
+            # Chunked binary reads, not line iteration: the heartbeat must
+            # tick on ANY bytes (e.g. `\r`-style progress bars that never
+            # emit a newline), or the watchdog would kill a healthy world.
+            chunk = raw.read1(65536)
+            if not chunk:
+                break
+            if heartbeat is not None:
+                heartbeat[0] = time.monotonic()
+            pending += chunk
+            lines = pending.splitlines(keepends=True)
+            if lines and not lines[-1].endswith((b"\n", b"\r")):
+                pending = lines.pop()
+            else:
+                pending = b""
+            for ln in lines:
+                sink.write(prefix + ln.decode(errors="replace"))
+            if lines:
+                sink.flush()
+        if pending:
+            sink.write(prefix + pending.decode(errors="replace") + "\n")
             sink.flush()
 
     t = threading.Thread(target=pump, daemon=True)
@@ -141,6 +166,7 @@ def launch_local(
     env: Optional[Dict[str, str]] = None,
     tag_output: bool = True,
     timeout: Optional[float] = None,
+    hang_timeout: Optional[float] = None,
     sink=None,
 ) -> int:
     """Run ``script`` in ``num_processes`` local python processes.
@@ -148,11 +174,19 @@ def launch_local(
     Returns the first nonzero child exit code, or 0. On any child
     failure (or timeout) the remaining children are terminated — the
     all-or-nothing semantics of an mpirun world.
+
+    ``hang_timeout``: failure-detection watchdog the reference lacks
+    (SURVEY.md §5 "Failure detection: absent"). A distributed world can
+    die without any process *exiting* — one rank stuck in a collective
+    the others already left never returns and never prints. If NO child
+    produces a line of output for ``hang_timeout`` seconds, the world is
+    declared hung and terminated (exit 125).
     """
     sink = sink or sys.stdout
     coordinator = f"127.0.0.1:{find_free_port()}"
     procs: List[subprocess.Popen] = []
     pumps: List[threading.Thread] = []
+    heartbeat = [time.monotonic()]  # updated by every pump thread
     for pid in range(num_processes):
         cenv = _child_env(
             dict(os.environ),
@@ -169,10 +203,11 @@ def launch_local(
                 env=cenv,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
-                text=True,
+                # binary pipe: _stream reads raw chunks so the hang
+                # watchdog sees un-newlined output too
             )
         )
-        pumps.append(_stream(procs[-1], pid, tag_output, sink))
+        pumps.append(_stream(procs[-1], pid, tag_output, sink, heartbeat))
 
     deadline = time.monotonic() + timeout if timeout else None
     exit_code = 0
@@ -193,6 +228,17 @@ def launch_local(
             if deadline and time.monotonic() > deadline:
                 sink.write(f"launch: timeout after {timeout}s; terminating\n")
                 exit_code = 124
+                raise _ChildFailed()
+            if (
+                hang_timeout
+                and time.monotonic() - heartbeat[0] > hang_timeout
+            ):
+                sink.write(
+                    f"launch: no output from any process for "
+                    f"{hang_timeout}s — declaring the world hung; "
+                    "terminating\n"
+                )
+                exit_code = 125
                 raise _ChildFailed()
             time.sleep(0.1)
     except (_ChildFailed, KeyboardInterrupt):
@@ -393,6 +439,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--project", default=None)
     ap.add_argument("--dry-run", action="store_true")
     ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument(
+        "--hang-timeout",
+        type=float,
+        default=None,
+        help="kill the world if no process prints for this many seconds "
+        "(deadlocked-collective watchdog)",
+    )
     ap.add_argument("--no-tag-output", action="store_true")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -407,6 +460,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--devices-per-process", args.devices_per_process),
             ("--platform", args.platform),
             ("--timeout", args.timeout),
+            ("--hang-timeout", args.hang_timeout),
         ):
             if val is not None:
                 ap.error(f"{flag} applies to local mode only, not --tpu")
@@ -435,6 +489,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         env=extra_env,
         tag_output=not args.no_tag_output,
         timeout=args.timeout,
+        hang_timeout=args.hang_timeout,
     )
 
 

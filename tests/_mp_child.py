@@ -105,7 +105,57 @@ def main() -> None:
         union = np.sort(both.reshape(-1))
         assert union.tolist() == list(range(32)), union  # disjoint + complete
 
-    print(f"MP_CHILD_OK {rank} loss={loss:.4f}")
+    # --- multi-host GSPMD: TP with params sharded ACROSS hosts -----------
+    # Axis order ("model", "data") is deliberately inverted from the
+    # production convention: row-major device order would otherwise put
+    # each model group entirely inside one process (devices 0-3 = host
+    # 0). With model outermost, every model-parallel group takes one
+    # device per row — {0,2,4,6} and {1,3,5,7} — spanning BOTH
+    # processes, so the Megatron column/row-parallel collectives really
+    # cross the host boundary (the branch no single-process test and no
+    # data-axis-only test can reach).
+    from distributeddeeplearning_tpu.models.vit import LOGICAL_RULES, ViT
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+    from distributeddeeplearning_tpu.training.pjit_step import (
+        create_sharded_train_state,
+        make_pjit_train_step,
+    )
+
+    tp_mesh = create_mesh(axes=("model", "data"), shape=(4, 2))
+    # every model group must contain devices from both processes
+    col0 = [tp_mesh.devices[m][0] for m in range(4)]
+    assert {d.process_index for d in col0} == {0, 1}, col0
+    vit = ViT(variant="ti", patch_size=16, num_classes=8, dtype=jnp.bfloat16)
+    tp_cfg = cfg.replace(num_classes=8, image_size=16)
+    tp_state = create_sharded_train_state(
+        vit, tp_cfg, tx, tp_mesh, LOGICAL_RULES, input_shape=(1, 16, 16, 3)
+    )
+    qkv = tp_state.params["block0"]["attn"]["qkv"]["kernel"]
+    assert "model" in tuple(qkv.sharding.spec), qkv.sharding
+    # each model shard now lives on a cross-host group: the param is not
+    # fully addressable from either process on the model axis itself
+    assert not qkv.is_fully_addressable
+    tp_step = make_pjit_train_step(vit, tx, tp_mesh, tp_cfg, donate_state=False)
+    # The data columns of this mesh also span hosts, so a process-local
+    # batch can't be assembled by concatenation; feed the SAME global
+    # batch from every process as a replicated array and let the step's
+    # sharding constraint reshard it onto the data axis inside jit.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng2 = np.random.RandomState(13)  # identical on both ranks
+    rep = NamedSharding(tp_mesh, P())
+    with tp_mesh:
+        tp_batch = (
+            jax.device_put(
+                rng2.uniform(-1, 1, size=(4, 16, 16, 3)).astype(np.float32), rep
+            ),
+            jax.device_put(rng2.randint(0, 8, size=(4,)).astype(np.int32), rep),
+        )
+        tp_state, tp_metrics = tp_step(tp_state, tp_batch)
+    tp_loss = float(tp_metrics["loss"])
+    assert np.isfinite(tp_loss), tp_loss
+
+    print(f"MP_CHILD_OK {rank} loss={loss:.4f} tp_loss={tp_loss:.4f}")
 
 
 if __name__ == "__main__":

@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
@@ -206,3 +207,27 @@ def test_dry_run_modes():
     assert res.returncode == 0
     assert "gcloud compute tpus tpu-vm ssh" in res.stdout
     assert "--worker=all" in res.stdout
+
+
+def test_hang_watchdog_kills_silent_world(tmp_path):
+    """Failure detection the reference lacks: a world whose processes are
+    alive but silent (deadlocked collective) is declared hung and killed
+    with exit 125."""
+    script = tmp_path / "hang.py"
+    script.write_text(
+        "import time\nprint('alive', flush=True)\ntime.sleep(300)\n"
+    )
+    t0 = time.time()
+    res = _run_launcher(
+        [
+            "--num-processes", "2",
+            "--hang-timeout", "4",
+            "--timeout", "120",
+            str(script),
+        ],
+        timeout=90,
+    )
+    out = res.stdout + res.stderr
+    assert res.returncode == 125, out[-2000:]
+    assert "declaring the world hung" in out, out[-2000:]
+    assert time.time() - t0 < 60  # watchdog fired, not the 120s timeout
