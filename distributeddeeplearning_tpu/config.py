@@ -61,11 +61,28 @@ class TrainConfig:
     # warmup 5 epochs + ×0.1 decay @30/60/80 (Keras :211-224, arXiv:1706.02677).
     batch_size_per_device: int = 64
     base_lr: float = 0.001
+    # "sgd" (reference parity) | "adamw" (LM-tier convention: decoupled
+    # weight decay on kernels, betas below).
+    optimizer: str = "sgd"
     momentum: float = 0.9
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.95  # LM-training convention; 0.999 for vision
+    adam_eps: float = 1e-8
+    # Decoupled weight decay (adamw only; applied to kernel params). The
+    # L2-in-loss `weight_decay` below is the reference's Keras semantics —
+    # set it to 0 when using adamw to avoid double regularization.
+    decoupled_weight_decay: float = 0.0
+    # Gradient accumulation: optimizer updates every k calls with the
+    # mean of the last k gradients (k× the effective batch without k×
+    # the memory). Works under every engine.
+    grad_accum_steps: int = 1
     weight_decay: float = 5e-5
     label_smoothing: float = 0.0
     epochs: int = 1
     warmup_epochs: int = 5
+    # "step" (reference ×0.1 @30/60/80) | "cosine" (warmup → cosine to 0
+    # over `epochs`) | "constant" (warmup → flat peak).
+    lr_schedule: str = "step"
     lr_decay_epochs: Tuple[int, ...] = (30, 60, 80)
     lr_decay_factor: float = 0.1
     # Optional per-boundary multiplicative factors (same length as
@@ -163,6 +180,16 @@ class TrainConfig:
             kw["moe_experts"] = int(e["MOE_EXPERTS"])
         if "DATA_FORMAT" in e:
             kw["data_format"] = e["DATA_FORMAT"]
+        if "OPTIMIZER" in e:
+            kw["optimizer"] = e["OPTIMIZER"]
+        if "LR_SCHEDULE" in e:
+            kw["lr_schedule"] = e["LR_SCHEDULE"]
+        if "GRAD_ACCUM_STEPS" in e:
+            kw["grad_accum_steps"] = int(e["GRAD_ACCUM_STEPS"])
+        if "WEIGHT_DECAY" in e:
+            kw["weight_decay"] = float(e["WEIGHT_DECAY"])
+        if "DECOUPLED_WEIGHT_DECAY" in e:
+            kw["decoupled_weight_decay"] = float(e["DECOUPLED_WEIGHT_DECAY"])
         if "ENGINE" in e:
             kw["engine"] = e["ENGINE"]
         # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)

@@ -133,6 +133,17 @@ def _load_image(
         return _transform_pil(img, size, train, rng)
 
 
+def _check_batch_divisible(global_batch_size: int, process_count: int) -> None:
+    """Config-error check, callable BEFORE any expensive dataset scan —
+    a bad batch/process combination must fail in milliseconds, not after
+    indexing a full ImageNet's worth of shards."""
+    if global_batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{process_count} processes"
+        )
+
+
 def _epoch_plan(
     length: int, global_batch_size: int, process_count: int, train: bool
 ) -> Tuple[int, int]:
@@ -140,11 +151,7 @@ def _epoch_plan(
     contract lives for every reader: global batch must divide across
     processes; train floors to full batches; eval ceils (exact coverage
     via pad+mask of the trailing batch)."""
-    if global_batch_size % process_count != 0:
-        raise ValueError(
-            f"global batch {global_batch_size} not divisible by "
-            f"{process_count} processes"
-        )
+    _check_batch_divisible(global_batch_size, process_count)
     if train:
         steps = max(length // global_batch_size, 1)
     else:
@@ -229,6 +236,7 @@ class ImageFolderDataset:
         process_count: int = 1,
         image_dtype=np.float32,
     ):
+        _check_batch_divisible(global_batch_size, process_count)
         self.image_dtype = np.dtype(image_dtype)
         self.samples, self.classes = _list_samples(root)
         self.num_classes = len(self.classes)
@@ -300,6 +308,7 @@ class TFRecordImageNetDataset:
         shuffle_buffer: int = 1024,
         image_dtype=np.float32,
     ):
+        _check_batch_divisible(global_batch_size, process_count)
         import tensorflow as tf
 
         tf.config.set_visible_devices([], "GPU")  # host-side pipeline only
@@ -476,6 +485,7 @@ class NativeTFRecordImageNetDataset:
     ):
         from distributeddeeplearning_tpu.native import index_tfrecord
 
+        _check_batch_divisible(global_batch_size, process_count)
         files = sorted(globlib.glob(file_pattern))
         if not files:
             raise FileNotFoundError(f"no TFRecord files match {file_pattern}")

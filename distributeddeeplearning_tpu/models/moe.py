@@ -195,6 +195,7 @@ class MoEDecoderBlock(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0
     seq_axis: Any = None
+    decode: bool = False  # KV-cache inference (inference.generate)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -208,6 +209,7 @@ class MoEDecoderBlock(nn.Module):
             self.dropout,
             causal=True,
             seq_axis=self.seq_axis,
+            decode=self.decode,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)

@@ -45,6 +45,7 @@ class DecoderBlock(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0
     seq_axis: Any = None
+    decode: bool = False  # KV-cache inference (inference.generate)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -56,6 +57,7 @@ class DecoderBlock(nn.Module):
             self.dropout,
             causal=True,
             seq_axis=self.seq_axis,
+            decode=self.decode,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -87,6 +89,10 @@ class TransformerLM(nn.Module):
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Autoregressive KV-cache inference mode (inference.generate): init
+    # with a full-length dummy to size the caches, then feed incremental
+    # tokens with mutable=["cache"].
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -123,6 +129,21 @@ class TransformerLM(nn.Module):
 
             start = lax.axis_index(self.seq_axis) * t
             pos_t = lax.dynamic_slice_in_dim(pos[0], start, t, axis=0)[None]
+        elif self.decode:
+            # Incremental decoding: these t tokens sit at absolute
+            # positions [pos_index, pos_index+t). The counter lives in
+            # the cache collection beside the attention KV caches.
+            from jax import lax
+
+            pidx = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if self.is_initializing():
+                pos_t = pos[:, :t]
+            else:
+                start = pidx.value
+                pos_t = lax.dynamic_slice_in_dim(pos[0], start, t, axis=0)[None]
+                pidx.value = start + t
         else:
             pos_t = pos[:, :t]
         x = x + pos_t.astype(self.dtype)
@@ -133,16 +154,25 @@ class TransformerLM(nn.Module):
             if self.moe_experts and i % self.moe_every == self.moe_every - 1:
                 from distributeddeeplearning_tpu.models.moe import MoEDecoderBlock
 
+                # Decode runs the mixture WITHOUT capacity dropping:
+                # dropping is a training-efficiency trick whose outcome
+                # depends on the chunk length, so it can never be
+                # consistent between incremental and full-sequence
+                # evaluation. capacity_factor = num_experts ⇒ capacity =
+                # k·s — every token always fits.
                 x = MoEDecoderBlock(
                     heads,
                     mlp_dim,
                     self.moe_experts,
                     self.moe_top_k,
-                    self.moe_capacity_factor,
+                    float(self.moe_experts)
+                    if self.decode
+                    else self.moe_capacity_factor,
                     dtype=self.dtype,
                     attn_impl=self.attn_impl,
                     dropout=self.dropout,
                     seq_axis=self.seq_axis,
+                    decode=self.decode,
                     name=f"block{i}",
                 )(x, train)
             else:
@@ -153,6 +183,7 @@ class TransformerLM(nn.Module):
                     self.attn_impl,
                     self.dropout,
                     seq_axis=self.seq_axis,
+                    decode=self.decode,
                     name=f"block{i}",
                 )(x, train)
 

@@ -26,6 +26,7 @@ import functools
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributeddeeplearning_tpu.ops.attention import dot_product_attention
@@ -88,6 +89,46 @@ class Attention(nn.Module):
     dropout: float = 0.0
     causal: bool = False  # decoder-only use (models/transformer_lm.py)
     seq_axis: Any = None  # mesh axis for impl='ring' (default "seq")
+    # Autoregressive inference: maintain a KV cache in the "cache"
+    # collection. Init with the FULL-length dummy input (that sizes the
+    # cache buffers), then apply with the prompt / one token at a time
+    # and mutable=["cache"] (driver: ``inference.generate``).
+    decode: bool = False
+
+    def _decode_attention(self, q, k, v):
+        """Single/few-token query against the growing KV cache. Static
+        shapes throughout: the cache is full-length from init and a
+        position mask hides the not-yet-written tail."""
+        from jax import lax
+
+        ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
+        ci = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if self.is_initializing():
+            # init traces the full-length dummy: buffers get their final
+            # [B, max_len, H, Dh] shape; run the normal path for tracing.
+            return dot_product_attention(q, k, v, causal=self.causal)
+        t = q.shape[1]
+        idx = ci.value
+        ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+        cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        ci.value = idx + t
+        k_all, v_all = ck.value, cv.value
+        length = k_all.shape[1]
+        head_dim = q.shape[-1]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", (q * head_dim**-0.5), k_all
+        ).astype(jnp.float32)
+        # query i sits at absolute position idx+i; it may attend to all
+        # cache slots <= that position (causal + written-so-far in one)
+        q_pos = idx + jnp.arange(t)
+        k_pos = jnp.arange(length)
+        mask = k_pos[None, :] <= q_pos[:, None]  # [t, length]
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -96,19 +137,24 @@ class Attention(nn.Module):
         qkv = _dense(3 * d, "qkv", ("embed", "heads"), self.dtype)(x)
         qkv = qkv.reshape(*x.shape[:-1], 3, self.num_heads, head_dim)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        # Params don't depend on the impl, and ring needs a bound mesh
-        # axis — init (traced outside shard_map) uses the xla path.
-        impl = self.attn_impl
-        if impl == "ring" and self.is_initializing():
-            impl = "xla"
-        out = dot_product_attention(
-            q,
-            k,
-            v,
-            causal=self.causal,
-            impl=impl,
-            axis_name=self.seq_axis,
-        )
+        if self.decode:
+            if not self.causal:
+                raise ValueError("decode=True requires causal attention")
+            out = self._decode_attention(q, k, v)
+        else:
+            # Params don't depend on the impl, and ring needs a bound mesh
+            # axis — init (traced outside shard_map) uses the xla path.
+            impl = self.attn_impl
+            if impl == "ring" and self.is_initializing():
+                impl = "xla"
+            out = dot_product_attention(
+                q,
+                k,
+                v,
+                causal=self.causal,
+                impl=impl,
+                axis_name=self.seq_axis,
+            )
         out = out.reshape(*x.shape[:-1], d)
         out = _dense(d, "proj", ("heads", "embed"), self.dtype)(out)
         if self.dropout > 0:

@@ -15,10 +15,21 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import optax
 
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.training.schedules import create_lr_schedule
+
+
+def _kernel_mask(params):
+    """True for conv/dense kernels only — the same set the L2-in-loss
+    penalty covers (train_step.l2_kernel_penalty), so decoupled decay
+    exempts biases/norm scales exactly like the reference's Keras L2."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: bool(path and getattr(path[-1], "key", None) == "kernel"),
+        params,
+    )
 
 
 def create_optimizer(
@@ -27,7 +38,43 @@ def create_optimizer(
     world_size: Optional[int] = None,
 ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
     """Returns ``(tx, lr_schedule)``; the schedule is also returned so
-    callbacks/loggers can report the current LR (Keras-parity)."""
-    schedule = create_lr_schedule(config, steps_per_epoch, world_size)
-    tx = optax.sgd(learning_rate=schedule, momentum=config.momentum, nesterov=False)
-    return tx, schedule
+    callbacks/loggers can report the current LR (Keras-parity).
+
+    ``config.optimizer``: "sgd" (reference parity) or "adamw" (decoupled
+    weight decay on kernels — pair with ``weight_decay=0`` to avoid
+    stacking the L2-in-loss term on top). ``config.grad_accum_steps > 1``
+    wraps the transform in ``optax.MultiSteps``: parameters move every k
+    calls using the mean of the last k gradients, so k micro-batches
+    train like one k×-sized batch under every engine.
+    """
+    k = max(config.grad_accum_steps, 1)
+    # MultiSteps advances the inner schedule once per UPDATE (every k
+    # micro-steps), so the schedule must be built in update units —
+    # steps_per_epoch/k updates per data epoch — or warmup/decay would
+    # land k epochs too late.
+    inner_schedule = create_lr_schedule(
+        config, max(steps_per_epoch // k, 1), world_size
+    )
+    if config.optimizer == "sgd":
+        tx = optax.sgd(
+            learning_rate=inner_schedule, momentum=config.momentum, nesterov=False
+        )
+    elif config.optimizer == "adamw":
+        tx = optax.adamw(
+            learning_rate=inner_schedule,
+            b1=config.adam_beta1,
+            b2=config.adam_beta2,
+            eps=config.adam_eps,
+            weight_decay=config.decoupled_weight_decay,
+            mask=_kernel_mask if config.decoupled_weight_decay else None,
+        )
+    else:
+        raise ValueError(
+            f"unknown optimizer {config.optimizer!r}; use sgd | adamw"
+        )
+    if k > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=k)
+        # Callers index the returned schedule by state.step (micro-steps)
+        # for logging; translate to update units for them.
+        return tx, (lambda step: inner_schedule(step // k))
+    return tx, inner_schedule

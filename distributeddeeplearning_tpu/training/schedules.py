@@ -24,7 +24,10 @@ def create_lr_schedule(
     steps_per_epoch: int,
     world_size: Optional[int] = None,
 ) -> optax.Schedule:
-    """Linear-warmup → piecewise-constant-decay schedule.
+    """Linear warmup into one of three decays (``config.lr_schedule``):
+    ``"step"`` — the reference's piecewise ×0.1 at 30/60/80;
+    ``"cosine"`` — cosine to 0 over ``config.epochs`` (LM convention);
+    ``"constant"`` — flat at peak.
 
     ``world_size`` defaults to the device count; peak LR = base_lr ×
     world_size (reference LR rule, BASELINE.md).
@@ -35,6 +38,35 @@ def create_lr_schedule(
         world_size = jax.device_count()
     peak = config.base_lr * (world_size if config.scale_lr_by_world_size else 1)
     warmup_steps = config.warmup_epochs * steps_per_epoch
+
+    if config.lr_schedule not in ("step", "cosine", "constant"):
+        raise ValueError(
+            f"unknown lr_schedule {config.lr_schedule!r}; "
+            "use step | cosine | constant"
+        )
+    if config.lr_schedule == "cosine":
+        total_steps = max(config.epochs * steps_per_epoch, warmup_steps + 1)
+        return optax.warmup_cosine_decay_schedule(
+            init_value=peak / max(world_size, 1) if warmup_steps > 0 else peak,
+            peak_value=peak,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+            end_value=0.0,
+        )
+    if config.lr_schedule == "constant":
+        if warmup_steps <= 0:
+            return optax.constant_schedule(peak)
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(
+                    init_value=peak / max(world_size, 1),
+                    end_value=peak,
+                    transition_steps=warmup_steps,
+                ),
+                optax.constant_schedule(peak),
+            ],
+            boundaries=[warmup_steps],
+        )
 
     factors = config.lr_decay_factors or (
         (config.lr_decay_factor,) * len(config.lr_decay_epochs)
