@@ -26,7 +26,15 @@ WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
 
-def run_bench(per_device_batch: int, devices=None, profile_dir=None):
+def run_bench(
+    per_device_batch: int,
+    devices=None,
+    profile_dir=None,
+    *,
+    model_name=None,
+    depth: int = 50,
+    image_size: int = 224,
+):
     import jax.numpy as jnp
     import ml_dtypes
     import optax
@@ -42,18 +50,22 @@ def run_bench(per_device_batch: int, devices=None, profile_dir=None):
     )
     from distributeddeeplearning_tpu.training.train_step import replicate_state
 
-    import os
-
-    # Smoke knobs (CPU-mesh tests): full protocol = depth 50 @ 224.
-    depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
-
     n_dev = devices if devices is not None else jax.device_count()
     global_batch = per_device_batch * n_dev
     cfg = TrainConfig(
         batch_size_per_device=per_device_batch, image_size=image_size
     )
-    model = ResNet(depth=depth, num_classes=1000, dtype=jnp.bfloat16)
+    # model_name (a vision-zoo registry name) measures that model under
+    # the same protocol (BASELINE configs: vit_b16, efficientnet_b4);
+    # default = the canonical ResNet50 line. All knobs are parsed once in
+    # main() and passed through so the metric name can never desync from
+    # the model actually benchmarked.
+    if model_name:
+        from distributeddeeplearning_tpu.models import get_model
+
+        model = get_model(model_name, num_classes=1000, dtype=jnp.bfloat16)
+    else:
+        model = ResNet(depth=depth, num_classes=1000, dtype=jnp.bfloat16)
     mesh = data_parallel_mesh(n_dev)
     tx, _ = create_optimizer(cfg, steps_per_epoch=cfg.steps_per_epoch())
     state = replicate_state(create_train_state(model, cfg, tx), mesh)
@@ -230,28 +242,43 @@ def main():
         batches = (int(os.environ["BENCH_BATCH"]),)
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
     image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
-    canonical = depth == 50 and image_size == 224
+    vision_model = os.environ.get("BENCH_MODEL")  # non-lm names land here
+    if vision_model == "resnet50":
+        # the canonical protocol by its registry name: keep the canonical
+        # metric name + vs_baseline instead of demoting the run
+        vision_model = None
+    canonical = depth == 50 and image_size == 224 and not vision_model
+    bench_kw = dict(model_name=vision_model, depth=depth, image_size=image_size)
     for per_device_batch in batches:
         try:
-            ips, n_dev = run_bench(per_device_batch, profile_dir=profile_dir)
+            ips, n_dev = run_bench(
+                per_device_batch, profile_dir=profile_dir, **bench_kw
+            )
             per_chip = ips / n_dev
             detail = {
                 "devices": n_dev,
                 "per_device_batch": per_device_batch,
                 "images_per_sec_per_device": round(per_chip, 1),
                 "platform": jax.devices()[0].platform,
-                "baseline_images_per_sec_per_device": REFERENCE_IMAGES_PER_SEC_PER_DEVICE,
-                "model_depth": depth,
                 "image_size": image_size,
             }
-            if not canonical:
-                detail["smoke_overrides"] = True
+            if vision_model:
+                # no baseline field: the V100 number is a ResNet50
+                # reference and means nothing for other architectures
+                detail["model"] = vision_model
+            else:
+                detail["model_depth"] = depth
+                detail["baseline_images_per_sec_per_device"] = (
+                    REFERENCE_IMAGES_PER_SEC_PER_DEVICE
+                )
+                if not canonical:
+                    detail["smoke_overrides"] = True
             if scaling and n_dev > 1:
                 # Scaling-efficiency path (BASELINE >90% target, 8→64):
                 # images/sec/chip at 1 device vs all attached devices. A
                 # failed rerun must not discard the valid N-device result.
                 try:
-                    ips1, _ = run_bench(per_device_batch, devices=1)
+                    ips1, _ = run_bench(per_device_batch, devices=1, **bench_kw)
                     detail["images_per_sec_1_device"] = round(ips1, 1)
                     detail["scaling_efficiency"] = round(per_chip / ips1, 4)
                 except Exception as e:
@@ -262,7 +289,11 @@ def main():
                         "metric": (
                             "resnet50_synthetic_train_images_per_sec"
                             if canonical
-                            else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
+                            else (
+                                f"{vision_model}_{image_size}px_images_per_sec"
+                                if vision_model
+                                else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
+                            )
                         ),
                         "value": round(ips, 1),
                         "unit": "images/sec",

@@ -111,6 +111,10 @@ class TrainConfig:
     # runtime); "pjit" = GSPMD engine consuming logical-axis annotations
     # (tensor parallelism over a mesh with a "model" axis).
     engine: str = "dp"
+    # Parameter-sharding rules for the pjit engine: "tp" (Megatron-style
+    # over a 'model'/'expert' axis — the default), "fsdp" (ZeRO-3:
+    # weights sharded over the data axis itself), "dp" (replicated).
+    param_sharding: str = "tp"
 
     # Bookkeeping
     seed: int = 42  # reference _SEED=42 (PyTorch :274-277, TF fake data :284)
@@ -192,6 +196,8 @@ class TrainConfig:
             kw["decoupled_weight_decay"] = float(e["DECOUPLED_WEIGHT_DECAY"])
         if "ENGINE" in e:
             kw["engine"] = e["ENGINE"]
+        if "PARAM_SHARDING" in e:
+            kw["param_sharding"] = e["PARAM_SHARDING"]
         # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)
         if "MESH_AXES" in e:
             kw["mesh_axes"] = tuple(

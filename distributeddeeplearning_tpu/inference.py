@@ -68,6 +68,8 @@ def generate(
     overridden here); ``params`` the trained parameters (e.g.
     ``state.params``). Greedy when ``temperature`` is 0 (default).
     """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, t_prompt = prompt.shape
@@ -88,14 +90,20 @@ def generate(
         return cached(params, jnp.asarray(prompt, jnp.int32), rng)
     decode_model = model.clone(decode=True, attn_impl="xla", seq_axis=None)
 
+    # Shape-only trace of init sizes the KV caches (full-length buffers);
+    # the actual cache is just zeros of those shapes — no parameter
+    # initializers or forward compute ever run for it.
+    cache_shapes = jax.eval_shape(
+        lambda r: decode_model.init(
+            r, jnp.zeros((b, max_len or total), jnp.int32), train=False
+        ),
+        jax.random.PRNGKey(0),
+    )["cache"]
+
     def run(params, prompt, rng):
-        # Full-length dummy init sizes the KV caches; params are unused
-        # (the trained ones are passed to every apply).
-        cache = decode_model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((b, max_len or total), jnp.int32),
-            train=False,
-        )["cache"]
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
         logits, mutated = decode_model.apply(
             {"params": params, "cache": cache},
             prompt,

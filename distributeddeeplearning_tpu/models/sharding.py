@@ -33,6 +33,33 @@ DATA_PARALLEL_RULES = tuple(
     for name, _ in LOGICAL_RULES
 )
 
+# FSDP / ZeRO-3: weights are sharded over the SAME mesh axis as the
+# batch. Every annotated kernel carries an "embed" dim, so mapping
+# "embed" → data splits each matrix once over the data axis; XLA's SPMD
+# partitioner inserts the per-layer all-gather in forward/backward and
+# the gradient reduce-scatter — exactly FSDP's communication pattern,
+# with no wrapper code. Optimizer moments inherit the same sharding
+# (pjit_step._constrain_params_like), which is ZeRO-1/2 for free.
+# Unannotated small params (LayerNorm, biases) stay replicated, the
+# standard FSDP choice. Select with PARAM_SHARDING=fsdp (pjit engine).
+FSDP_RULES = tuple(
+    (name, ("replica", "data") if name == "batch" else
+     ("data" if name == "embed" else None))
+    for name, _ in LOGICAL_RULES
+)
+
+
+def rules_table(name: str):
+    """Named rules tables: "tp" (tensor/expert parallel, the default),
+    "fsdp" (weights sharded over the data axis), "dp" (everything
+    replicated except the batch)."""
+    tables = {"tp": LOGICAL_RULES, "fsdp": FSDP_RULES, "dp": DATA_PARALLEL_RULES}
+    if name not in tables:
+        raise ValueError(
+            f"unknown sharding rules {name!r}; use {sorted(tables)}"
+        )
+    return tables[name]
+
 
 def rules_for_mesh(mesh, rules=LOGICAL_RULES):
     """Project a rules table onto a concrete mesh: any rule whose target

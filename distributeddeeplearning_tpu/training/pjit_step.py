@@ -185,14 +185,14 @@ def make_pjit_train_step(
     (committed state + batch), so the same function serves DP, TP and
     DP×TP meshes."""
     from distributeddeeplearning_tpu.models.sharding import (
-        LOGICAL_RULES,
         rules_for_mesh,
+        rules_table,
     )
 
     cfg = config or TrainConfig()
     base_rng = jax.random.PRNGKey(cfg.seed)
     batch_sharding = _mesh_batch_sharding(mesh)
-    rules = list(rules_for_mesh(mesh, LOGICAL_RULES))
+    rules = list(rules_for_mesh(mesh, rules_table(cfg.param_sharding)))
 
     def step(state: TrainState, batch: Batch):
         images, labels = batch
@@ -299,15 +299,17 @@ def build_pjit_state(
 ) -> TrainState:
     """One construction point for engine='pjit' state (used by loop.fit,
     the explicit front-end, and Keras load_weights): sharded-at-birth
-    init under the model-neutral rules table."""
-    from distributeddeeplearning_tpu.models.sharding import LOGICAL_RULES
+    init under the rules table ``config.param_sharding`` names ("tp" —
+    the model-neutral default; "fsdp" — ZeRO-3 over the data axis;
+    "dp" — replicated)."""
+    from distributeddeeplearning_tpu.models.sharding import rules_table
 
     return create_sharded_train_state(
         model,
         config,
         tx,
         mesh,
-        LOGICAL_RULES,
+        rules_table(config.param_sharding),
         input_shape=input_shape,
         input_dtype=input_dtype,
     )

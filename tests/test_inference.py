@@ -94,6 +94,9 @@ def test_length_guard():
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(model, params, np.zeros((1, 30), np.int32),
                  max_new_tokens=10)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, params, np.zeros((1, 4), np.int32),
+                 max_new_tokens=0)
 
 
 def test_single_new_token():
