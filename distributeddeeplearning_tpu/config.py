@@ -55,6 +55,9 @@ class TrainConfig:
     # Mixture-of-Experts width for MoE-capable models (the LM families):
     # None keeps each model's own default (8 for lm_moe_*, dense for lm_*).
     moe_experts: Optional[int] = None
+    # Gradient checkpointing for block-structured models (ViT/LM/pipeline
+    # stages): recompute activations in backward — O(depth) memory.
+    remat: bool = False
 
     # Optimization — reference constants: LR 0.001 × world size
     # (TF :154, PyTorch :333), momentum 0.9, L2 5e-5 (Keras :97-116),
@@ -133,6 +136,8 @@ class TrainConfig:
         )
         if self.moe_experts is not None:
             kw["moe_experts"] = self.moe_experts
+        if self.remat:
+            kw["remat"] = True
         return kw
 
     @property
@@ -182,6 +187,8 @@ class TrainConfig:
             kw["attn_impl"] = e["ATTN_IMPL"]
         if "MOE_EXPERTS" in e:
             kw["moe_experts"] = int(e["MOE_EXPERTS"])
+        if "REMAT" in e:
+            kw["remat"] = _str_to_bool(e["REMAT"])
         if "DATA_FORMAT" in e:
             kw["data_format"] = e["DATA_FORMAT"]
         if "OPTIMIZER" in e:

@@ -85,7 +85,7 @@ def setup(
             input_shape=input_shape, input_dtype=input_dtype,
         )
         train_step = make_pjit_train_step(model, tx, mesh, config)
-        eval_step = make_pjit_eval_step(model, mesh)
+        eval_step = make_pjit_eval_step(model, mesh, config)
     else:
         state = replicate_state(
             create_train_state(

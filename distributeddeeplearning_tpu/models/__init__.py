@@ -29,6 +29,7 @@ from distributeddeeplearning_tpu.models.vit import ViT
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
 _ATTENTION_MODELS: set = set()
 _MOE_MODELS: set = set()
+_REMAT_MODELS: set = set()
 
 
 def register_model(
@@ -37,12 +38,15 @@ def register_model(
     *,
     attention: bool = False,
     moe: bool = False,
+    remat: bool = False,
 ) -> None:
     _REGISTRY[name.lower()] = factory
     if attention:
         _ATTENTION_MODELS.add(name.lower())
     if moe:
         _MOE_MODELS.add(name.lower())
+    if remat:
+        _REMAT_MODELS.add(name.lower())
 
 
 def get_model(
@@ -52,6 +56,7 @@ def get_model(
     dtype=jnp.bfloat16,
     attn_impl: str = None,
     moe_experts: int = None,
+    remat: bool = None,
     **kw,
 ):
     """Instantiate a model by name (e.g. ``"resnet50"``).
@@ -74,6 +79,8 @@ def get_model(
         kw["attn_impl"] = attn_impl
     if moe_experts is not None and key in _MOE_MODELS:
         kw["moe_experts"] = moe_experts
+    if remat is not None and key in _REMAT_MODELS:
+        kw["remat"] = remat
     if num_classes is not None:
         kw["num_classes"] = num_classes
     return _REGISTRY[key](dtype=dtype, **kw)
@@ -98,6 +105,7 @@ for _variant in ("ti", "s", "b", "l", "h"):
             variant=v, patch_size=16, num_classes=num_classes, dtype=dtype,
             **kw)))(_variant),
         attention=True,
+        remat=True,
     )
 
 # Decoder-only LM family (long-context tier; num_classes = vocab size).
@@ -108,6 +116,7 @@ for _v in ("tiny", "small", "base", "large"):
             variant=v, vocab_size=num_classes, dtype=dtype, **kw)))(_v),
         attention=True,
         moe=True,  # dense by default; MOE_EXPERTS turns on routed FFNs
+        remat=True,
     )
     # MoE variant (expert-parallel tier, models/moe.py): every 2nd block's
     # FFN routed over 8 experts by default; override via moe_experts=...
@@ -120,6 +129,7 @@ for _v in ("tiny", "small", "base", "large"):
                 moe_experts=moe_experts, **kw)))(_v),
         attention=True,
         moe=True,
+        remat=True,
     )
 
 # EfficientNet family (BASELINE.json config: EfficientNet-B4).

@@ -139,7 +139,7 @@ class MoEMlpBlock(nn.Module):
             "bsec,bsd->ebcd", dispatch.astype(self.dtype), x.astype(self.dtype)
         )
         expert_in = nn.with_logical_constraint(
-            expert_in, ("expert", "batch", None, "embed")
+            expert_in, ("expert", "batch", None, "act_embed")
         )
 
         w1 = self.param(
@@ -174,7 +174,9 @@ class MoEMlpBlock(nn.Module):
         h = nn.gelu(h + b1[:, None, None, :].astype(self.dtype))
         out = jnp.einsum("ebch,ehd->ebcd", h, w2.astype(self.dtype))
         out = out + b2[:, None, None, :].astype(self.dtype)
-        out = nn.with_logical_constraint(out, ("expert", "batch", None, "embed"))
+        out = nn.with_logical_constraint(
+            out, ("expert", "batch", None, "act_embed")
+        )
 
         y = jnp.einsum(
             "bsec,ebcd->bsd", combine.astype(self.dtype), out

@@ -73,7 +73,11 @@ class EmbedIn(nn.Module):
 
 
 class StageCore(nn.Module):
-    """``n_layers`` decoder blocks — one pipeline stage's compute."""
+    """``n_layers`` decoder blocks — one pipeline stage's compute.
+
+    ``remat``: recompute layer activations during backward; with GPipe's
+    all-microbatches-live activation footprint this is the knob that
+    keeps deep stages in HBM."""
 
     n_layers: int
     num_heads: int
@@ -81,11 +85,17 @@ class StageCore(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     dropout: float = 0.0
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        block = (
+            nn.remat(DecoderBlock, static_argnums=(2,))
+            if self.remat
+            else DecoderBlock
+        )
         for i in range(self.n_layers):
-            x = DecoderBlock(
+            x = block(
                 self.num_heads,
                 self.mlp_dim,
                 self.dtype,
@@ -135,6 +145,7 @@ class PipelineLM:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     dropout: float = 0.0
+    remat: bool = False
 
     @property
     def dims(self) -> Tuple[int, int, int, int]:
@@ -157,7 +168,7 @@ class PipelineLM:
         embed = EmbedIn(self.vocab_size, hidden, self.max_seq_len, self.dtype)
         core = StageCore(
             self.layers_per_stage, heads, mlp_dim, self.dtype,
-            self.attn_impl, self.dropout,
+            self.attn_impl, self.dropout, remat=self.remat,
         )
         head = HeadOut(self.vocab_size, self.dtype)
         return embed, core, head

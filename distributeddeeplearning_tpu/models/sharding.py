@@ -26,6 +26,10 @@ LOGICAL_RULES = (
     # shard over the mesh's "expert" axis; the dispatch einsum boundary
     # becomes the token all-to-all.
     ("expert", "expert"),
+    # Activation feature dim (distinct from the WEIGHT "embed" axis so
+    # FSDP — which maps weight-embed onto the data axis — never produces
+    # a duplicate-axis spec on activations that also carry "batch").
+    ("act_embed", None),
 )
 
 DATA_PARALLEL_RULES = tuple(

@@ -93,6 +93,10 @@ class TransformerLM(nn.Module):
     # with a full-length dummy to size the caches, then feed incremental
     # tokens with mutable=["cache"].
     decode: bool = False
+    # Gradient checkpointing (rematerialization): recompute each block's
+    # activations during backward instead of storing them — trades ~1
+    # extra forward of FLOPs for O(depth) activation memory. REMAT=1.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -150,17 +154,25 @@ class TransformerLM(nn.Module):
         if self.dropout > 0:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
+        dense_block, moe_block = DecoderBlock, None
+        if self.moe_experts:
+            from distributeddeeplearning_tpu.models.moe import MoEDecoderBlock
+
+            moe_block = MoEDecoderBlock
+        if self.remat and not self.decode:
+            # static_argnums: `train` is a Python bool, not a tracer
+            dense_block = nn.remat(DecoderBlock, static_argnums=(2,))
+            if moe_block is not None:
+                moe_block = nn.remat(moe_block, static_argnums=(2,))
         for i in range(depth):
             if self.moe_experts and i % self.moe_every == self.moe_every - 1:
-                from distributeddeeplearning_tpu.models.moe import MoEDecoderBlock
-
                 # Decode runs the mixture WITHOUT capacity dropping:
                 # dropping is a training-efficiency trick whose outcome
                 # depends on the chunk length, so it can never be
                 # consistent between incremental and full-sequence
                 # evaluation. capacity_factor = num_experts ⇒ capacity =
                 # k·s — every token always fits.
-                x = MoEDecoderBlock(
+                x = moe_block(
                     heads,
                     mlp_dim,
                     self.moe_experts,
@@ -176,7 +188,7 @@ class TransformerLM(nn.Module):
                     name=f"block{i}",
                 )(x, train)
             else:
-                x = DecoderBlock(
+                x = dense_block(
                     heads,
                     mlp_dim,
                     self.dtype,

@@ -190,6 +190,9 @@ class ViT(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     dropout: float = 0.0
+    # Gradient checkpointing: recompute block activations in backward
+    # (REMAT=1 via config) — O(depth) activation memory for one extra fwd.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -234,8 +237,13 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(self.dtype)
 
+        block = (
+            nn.remat(EncoderBlock, static_argnums=(2,))
+            if self.remat
+            else EncoderBlock
+        )
         for i in range(depth):
-            x = EncoderBlock(
+            x = block(
                 heads,
                 mlp_dim,
                 self.dtype,

@@ -64,6 +64,18 @@ def resolve_engine(config, mesh=None):
 
     if config.engine not in ("dp", "pjit"):
         raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
+    # Validate the rules-table name eagerly (raises for unknown values),
+    # and refuse a non-default PARAM_SHARDING under the dp engine — the
+    # shard_map engine replicates params, so the user would silently NOT
+    # get the ZeRO-3 memory savings they asked for.
+    from distributeddeeplearning_tpu.models.sharding import rules_table
+
+    rules_table(config.param_sharding)
+    if config.engine != "pjit" and config.param_sharding != "tp":
+        raise ValueError(
+            f"PARAM_SHARDING={config.param_sharding!r} requires ENGINE=pjit "
+            "(the dp engine keeps parameters replicated)"
+        )
     mesh = mesh if mesh is not None else mesh_from_config(config)
     return config.engine == "pjit", mesh
 
@@ -183,7 +195,9 @@ def fit(
 
         train_step = make_pjit_train_step(model, tx, mesh, config)
         eval_step = (
-            make_pjit_eval_step(model, mesh) if eval_data is not None else None
+            make_pjit_eval_step(model, mesh, config)
+            if eval_data is not None
+            else None
         )
     else:
         train_step = make_train_step(model, tx, mesh, config)
@@ -284,7 +298,7 @@ def evaluate(
             make_pjit_eval_step,
         )
 
-        eval_step = make_pjit_eval_step(model, mesh)
+        eval_step = make_pjit_eval_step(model, mesh, config)
     else:
         eval_step = make_eval_step(model, mesh)
     return _run_eval(eval_step, state, eval_data, mesh, config)

@@ -243,20 +243,25 @@ def make_pjit_train_step(
 
 
 def make_pjit_eval_step(
-    model, mesh: Mesh
+    model, mesh: Mesh, config: Optional[TrainConfig] = None
 ) -> Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]:
     """Same eval contract as the DP engine (``train_step.make_eval_step``):
     accepts ``(images, labels[, weights])``, returns weighted batch means
     plus the real-sample ``count`` — with GSPMD the weighted sums are
-    plain global reductions, no explicit psum needed."""
+    plain global reductions, no explicit psum needed.
+
+    ``config`` selects the same ``param_sharding`` rules table the train
+    step uses, so eval activations are constrained under the identical
+    layout (TP vs FSDP vs DP must not diverge between the two)."""
     from distributeddeeplearning_tpu.models.sharding import (
-        LOGICAL_RULES,
         rules_for_mesh,
+        rules_table,
     )
     from distributeddeeplearning_tpu.training.train_step import eval_metrics_fn
 
+    cfg = config or TrainConfig()
     batch_sharding = _mesh_batch_sharding(mesh)
-    rules = list(rules_for_mesh(mesh, LOGICAL_RULES))
+    rules = list(rules_for_mesh(mesh, rules_table(cfg.param_sharding)))
 
     def eval_step(state: TrainState, batch):
         images, labels, weights = batch

@@ -67,7 +67,7 @@ def main():
     n_layers = -(-depth // stages) * stages
     pl = PipelineLM(
         variant=variant, vocab_size=vocab, max_seq_len=seq_len,
-        num_stages=stages, n_layers=n_layers,
+        num_stages=stages, n_layers=n_layers, remat=config.remat,
     )
     logger.info(
         "PP LM: %s over %d stages x %d-way DP, %d microbatches",

@@ -3,11 +3,20 @@
 import numpy as np
 
 
-def test_run_bench_smoke(monkeypatch, mesh8):
-    monkeypatch.setenv("BENCH_DEPTH", "18")
-    monkeypatch.setenv("BENCH_IMAGE_SIZE", "16")
+def test_run_bench_smoke(mesh8):
+    # knobs are explicit parameters now (main() owns the env parsing)
     import bench
 
-    ips, n_dev = bench.run_bench(2, devices=2)
+    ips, n_dev = bench.run_bench(2, devices=2, depth=18, image_size=16)
+    assert n_dev == 2
+    assert np.isfinite(ips) and ips > 0
+
+
+def test_run_bench_named_model_smoke(mesh8):
+    import bench
+
+    ips, n_dev = bench.run_bench(
+        2, devices=2, model_name="vit_ti16", image_size=16
+    )
     assert n_dev == 2
     assert np.isfinite(ips) and ips > 0

@@ -1,0 +1,105 @@
+"""Determinism audit — the framework's answer to SURVEY §5's "race
+detection: absent" row.
+
+On TPU the classic data-race detectors don't apply; the meaningful
+property is *bitwise run-to-run reproducibility* of the compiled step:
+same seed + same data ⇒ identical parameters, across process restarts
+and across engines. A nondeterministic reduction, an unseeded rng, or
+host-order-dependent batch assembly breaks these assertions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.pipeline import shard_batch
+from distributeddeeplearning_tpu.data.synthetic import SyntheticImageDataset
+from distributeddeeplearning_tpu.models.resnet import ResNet
+from distributeddeeplearning_tpu.training import create_train_state, make_train_step
+from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+CFG = TrainConfig(num_classes=8, image_size=16, batch_size_per_device=2,
+                  compute_dtype="float32")
+
+
+def _run_twice(build_and_train):
+    a = build_and_train()
+    b = build_and_train()
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_dp_step_bitwise_reproducible(mesh8):
+    """Full rebuild (init + compile + 3 steps) twice ⇒ bitwise-identical
+    parameters. Covers seeded init, dropout rng derivation, and the
+    pmean reduction order."""
+    rng = np.random.RandomState(0)
+    batch_np = (
+        rng.randn(16, 16, 16, 3).astype(np.float32),
+        rng.randint(0, 8, size=(16,)).astype(np.int32),
+    )
+
+    def build_and_train():
+        model = ResNet(depth=18, num_classes=8, dtype=jnp.float32)
+        tx = optax.sgd(0.1, momentum=0.9)
+        state = replicate_state(create_train_state(model, CFG, tx), mesh8)
+        step = make_train_step(model, tx, mesh8, CFG, donate_state=False)
+        batch = shard_batch(batch_np, mesh8)
+        for _ in range(3):
+            state, _ = step(state, batch)
+        return jax.device_get(state.params)
+
+    _run_twice(build_and_train)
+
+
+def test_stochastic_model_reproducible(mesh8):
+    """Dropout draws from a derived (seed, step, device) key — two
+    identical runs of a stochastic model must still agree bitwise."""
+    from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+
+    vocab, t = 32, 8
+    rng = np.random.RandomState(1)
+    rows = rng.randint(0, vocab, size=(16, t + 1)).astype(np.int32)
+    cfg = CFG.replace(num_classes=vocab)
+
+    def build_and_train():
+        model = TransformerLM(
+            variant="tiny", vocab_size=vocab, max_seq_len=t,
+            dtype=jnp.float32, dropout=0.1,
+        )
+        tx = optax.sgd(0.1)
+        state = replicate_state(
+            create_train_state(model, cfg, tx, input_shape=(1, t),
+                               input_dtype=jnp.int32),
+            mesh8,
+        )
+        step = make_train_step(model, tx, mesh8, cfg, donate_state=False)
+        batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh8)
+        for _ in range(2):
+            state, _ = step(state, batch)
+        return jax.device_get(state.params)
+
+    _run_twice(build_and_train)
+
+
+def test_dataset_stream_reproducible():
+    """The synthetic pipeline (incl. the native counter-mode fill) is a
+    pure function of (seed, epoch, process): two constructions yield
+    byte-identical batches, different seeds differ."""
+    def batches(seed):
+        ds = SyntheticImageDataset(
+            length=64, global_batch_size=16, image_size=8, num_classes=4,
+            num_physical_batches=2, seed=seed,
+        )
+        return [b for b in ds.epoch(0)] + [b for b in ds.epoch(1)]
+
+    for (xa, ya), (xb, yb) in zip(batches(42), batches(42)):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+    diff = any(
+        not np.array_equal(a[0], b[0])
+        for a, b in zip(batches(42), batches(43))
+    )
+    assert diff

@@ -161,3 +161,38 @@ def test_fsdp_checkpoint_roundtrip(tmp_path, mesh8):
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
     )
+
+
+def test_fsdp_moe_composition(mesh8):
+    """FSDP × MoE must not collide on the data axis: weight-embed shards
+    over data while the MoE activation constraints use the distinct
+    'act_embed' logical name (replicated), so the spec never names one
+    mesh axis twice."""
+    model = TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=T,
+        dtype=jnp.float32, moe_experts=4,
+    )
+    tx = optax.sgd(0.1)
+    state = create_sharded_train_state(
+        model, CFG, tx, mesh8, FSDP_RULES,
+        input_shape=(1, T), input_dtype=jnp.int32,
+    )
+    w1 = state.params["block1"]["moe"]["w1"]
+    # expert weights: ("expert","embed","mlp") -> embed dim over data
+    assert tuple(w1.sharding.spec)[:2] == (None, "data"), w1.sharding
+    step = make_pjit_train_step(model, tx, mesh8, CFG, donate_state=False)
+    rng = np.random.RandomState(7)
+    rows = rng.randint(0, VOCAB, size=(16, T + 1)).astype(np.int32)
+    with mesh8:
+        batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh8)
+        state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_param_sharding_validation():
+    from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+    with pytest.raises(ValueError, match="unknown sharding rules"):
+        resolve_engine(TrainConfig(engine="pjit", param_sharding="zero2"))
+    with pytest.raises(ValueError, match="requires ENGINE=pjit"):
+        resolve_engine(TrainConfig(engine="dp", param_sharding="fsdp"))
