@@ -10,7 +10,8 @@ framework needs one. TPU-first design:
   compiles to one XLA program.
 * **One jitted program** — prefill (the whole prompt in one forward)
   followed by a ``lax.scan`` over single-token decode steps; sampling
-  (greedy / temperature / top-k) happens on-device inside the scan.
+  (greedy / temperature / top-k / top-p nucleus) happens on-device
+  inside the scan.
 * Works for the dense and MoE LM families (any ``TransformerLM``).
 
 Usage::
@@ -18,7 +19,7 @@ Usage::
     from distributeddeeplearning_tpu.inference import generate
     tokens = generate(model, state.params, prompt,   # [B, Tp] int32
                       max_new_tokens=64, temperature=0.8, top_k=40,
-                      rng=jax.random.PRNGKey(0))
+                      top_p=0.95, rng=jax.random.PRNGKey(0))
 """
 
 from __future__ import annotations
@@ -32,14 +33,39 @@ from jax import lax
 PyTree = object
 
 
-def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: Optional[int]):
-    """Next token from ``[B, V]`` logits. temperature 0 = greedy."""
+def _sample(
+    logits: jnp.ndarray,
+    rng,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float] = None,
+):
+    """Next token from ``[B, V]`` logits. temperature 0 = greedy;
+    ``top_k`` keeps the k most likely tokens; ``top_p`` keeps the
+    smallest set of tokens whose probability mass reaches p (nucleus
+    sampling). Both filters compose (intersection)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    neg_inf = jnp.finfo(jnp.float32).min
     logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None or top_p is not None:
+        # one descending sort serves both filters (this runs per token
+        # inside the decode scan — don't sort twice)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+        kth = sorted_logits[:, top_k - 1][:, None]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+    if top_p is not None:
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < p (so the token
+        # that crosses p is included — the standard nucleus rule)
+        keep_sorted = (cum - probs) < top_p
+        # threshold = smallest kept logit; everything below is cut
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+        )[:, None]
+        logits = jnp.where(logits < threshold, neg_inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -59,6 +85,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, Tp]
@@ -70,6 +97,8 @@ def generate(
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, t_prompt = prompt.shape
@@ -81,7 +110,9 @@ def generate(
             f"model.max_seq_len {max_len}"
         )
     try:
-        cache_key = (model, b, t_prompt, max_new_tokens, temperature, top_k)
+        cache_key = (
+            model, b, t_prompt, max_new_tokens, temperature, top_k, top_p
+        )
         cached = _SAMPLER_CACHE.get(cache_key)
     except TypeError:  # unhashable model: no caching
         cache_key = None
@@ -111,7 +142,7 @@ def generate(
             mutable=["cache"],
         )
         rng_0, rng_loop = jax.random.split(rng)
-        first = _sample(logits[:, -1], rng_0, temperature, top_k)
+        first = _sample(logits[:, -1], rng_0, temperature, top_k, top_p)
 
         def body(carry, step_rng):
             cache, tok = carry
@@ -121,7 +152,7 @@ def generate(
                 train=False,
                 mutable=["cache"],
             )
-            nxt = _sample(logits[:, -1], step_rng, temperature, top_k)
+            nxt = _sample(logits[:, -1], step_rng, temperature, top_k, top_p)
             return (mutated["cache"], nxt), nxt
 
         if max_new_tokens == 1:

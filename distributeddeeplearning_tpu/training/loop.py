@@ -71,7 +71,9 @@ def resolve_engine(config, mesh=None):
     from distributeddeeplearning_tpu.models.sharding import rules_table
 
     rules_table(config.param_sharding)
-    if config.engine != "pjit" and config.param_sharding != "tp":
+    # Only "fsdp" is meaningless under the dp engine ("dp" rules =
+    # replicated params, which is exactly what the shard_map engine does).
+    if config.engine != "pjit" and config.param_sharding == "fsdp":
         raise ValueError(
             f"PARAM_SHARDING={config.param_sharding!r} requires ENGINE=pjit "
             "(the dp engine keeps parameters replicated)"

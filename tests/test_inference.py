@@ -106,3 +106,45 @@ def test_single_new_token():
     got = np.asarray(generate(model, params, prompt, max_new_tokens=1))
     ref = _greedy_reference(model, params, prompt, 1)
     np.testing.assert_array_equal(got, ref)
+
+
+def test_top_p_sampling():
+    """Nucleus filter: with a peaked distribution and small p, sampling
+    can only return the top token; the filter composes with top_k."""
+    from distributeddeeplearning_tpu.inference import _sample
+
+    logits = jnp.asarray(
+        [[10.0, 5.0, 1.0, 0.0], [0.0, 10.0, 9.9, 1.0]], jnp.float32
+    )
+    # p small enough that only the argmax survives in row 0; row 1's top
+    # two are near-equal so p=0.9 keeps both
+    for _ in range(8):
+        tok = _sample(logits, jax.random.PRNGKey(_), 1.0, None, 0.5)
+        assert int(tok[0]) == 0
+    seen = {
+        int(_sample(logits, jax.random.PRNGKey(s), 1.0, None, 0.9)[1])
+        for s in range(32)
+    }
+    assert seen <= {1, 2}
+    assert len(seen) == 2  # both nucleus members actually get sampled
+    # end-to-end through generate()
+    model = _model()
+    params = _params(model)
+    out = generate(model, params, np.zeros((1, 3), np.int32),
+                   max_new_tokens=5, temperature=1.0, top_p=0.8,
+                   rng=jax.random.PRNGKey(0))
+    assert np.asarray(out).shape == (1, 8)
+
+
+def test_top_p_validation_and_dp_rules_allowed():
+    model = _model()
+    params = _params(model)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, np.zeros((1, 3), np.int32),
+                 max_new_tokens=2, temperature=1.0, top_p=0.0)
+    # PARAM_SHARDING=dp under the dp engine is valid (replicated params)
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+    use_pjit, _ = resolve_engine(TrainConfig(engine="dp", param_sharding="dp"))
+    assert not use_pjit
