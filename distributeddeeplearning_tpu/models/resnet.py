@@ -42,6 +42,18 @@ _STAGES = {
 _KERNEL_INIT = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: (N, H, W, C) → (N, H/b, W/b, C·b²).
+
+    The MLPerf ResNet input trick: folds the 2× stem stride into the
+    channel dim so the stem conv sees 12 input channels instead of 3 and
+    tiles the MXU's 128-lane contraction instead of padding 3→128."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, c * block * block)
+
+
 def _conv(
     filters: int,
     kernel: int,
@@ -70,15 +82,28 @@ def _conv(
     )
 
 
-def _batch_norm(train: bool, dtype, zero_init: bool = False, name: str = None):
+def _batch_norm(
+    train: bool,
+    dtype,
+    zero_init: bool = False,
+    name: str = None,
+    stats_dtype=jnp.float32,
+):
     """BN with reference constants: momentum .9, eps 1e-5
-    (resnet_model.py:10-11); optionally zero-init gamma (:150, :201)."""
+    (resnet_model.py:10-11); optionally zero-init gamma (:150, :201).
+
+    ``stats_dtype`` != float32 turns off flax's f32 promotion of the
+    batch-statistics reduction (PROFILE.md roadmap item 2 — measured a
+    no-win on v5e, and its fast-variance form cancels catastrophically
+    for channels with std ≪ |mean| in bf16; default stays f32).
+    """
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-5,
         dtype=dtype,
         param_dtype=jnp.float32,
+        force_float32_reductions=jnp.dtype(stats_dtype) == jnp.float32,
         scale_init=nn.initializers.zeros if zero_init else nn.initializers.ones,
         name=name,
     )
@@ -90,18 +115,22 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
+    stats_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        bn = functools.partial(
+            _batch_norm, train, self.dtype, stats_dtype=self.stats_dtype
+        )
         residual = x
         y = _conv(self.filters, 3, self.strides, self.dtype)(x)
-        y = _batch_norm(train, self.dtype)(y)
+        y = bn()(y)
         y = nn.relu(y)
         y = _conv(self.filters, 3, 1, self.dtype)(y)
-        y = _batch_norm(train, self.dtype, zero_init=True)(y)
+        y = bn(zero_init=True)(y)
         if residual.shape != y.shape:
             residual = _conv(self.filters, 1, self.strides, self.dtype, name="proj_conv")(x)
-            residual = _batch_norm(train, self.dtype, name="proj_bn")(residual)
+            residual = bn(name="proj_bn")(residual)
         return nn.relu(y + residual)
 
 
@@ -111,21 +140,25 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
+    stats_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        bn = functools.partial(
+            _batch_norm, train, self.dtype, stats_dtype=self.stats_dtype
+        )
         residual = x
         y = _conv(self.filters, 1, 1, self.dtype)(x)
-        y = _batch_norm(train, self.dtype)(y)
+        y = bn()(y)
         y = nn.relu(y)
         y = _conv(self.filters, 3, self.strides, self.dtype)(y)
-        y = _batch_norm(train, self.dtype)(y)
+        y = bn()(y)
         y = nn.relu(y)
         y = _conv(4 * self.filters, 1, 1, self.dtype)(y)
-        y = _batch_norm(train, self.dtype, zero_init=True)(y)
+        y = bn(zero_init=True)(y)
         if residual.shape != y.shape:
             residual = _conv(4 * self.filters, 1, self.strides, self.dtype, name="proj_conv")(x)
-            residual = _batch_norm(train, self.dtype, name="proj_bn")(residual)
+            residual = bn(name="proj_bn")(residual)
         return nn.relu(y + residual)
 
 
@@ -140,6 +173,13 @@ class ResNet(nn.Module):
     depth: int = 50
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    # PROFILE.md byte-reduction knobs (default off = exact round-2
+    # semantics). stats_dtype: dtype of the BN batch-statistics reduction.
+    # s2d_stem: MLPerf space-to-depth input — the 7×7/2 stem conv on
+    # 224²×3 becomes a 4×4/1 conv on 112²×12 (same 2× downsample, the
+    # 8×8-pixel support supersets the original 7×7 receptive field).
+    stats_dtype: Any = jnp.float32
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -151,8 +191,12 @@ class ResNet(nn.Module):
         block = BasicBlock if kind == "basic" else BottleneckBlock
 
         x = jnp.asarray(x, self.dtype)
-        x = _conv(64, 7, 2, self.dtype, name="stem_conv")(x)
-        x = _batch_norm(train, self.dtype, name="stem_bn")(x)
+        if self.s2d_stem:
+            x = space_to_depth(x, 2)
+            x = _conv(64, 4, 1, self.dtype, name="stem_conv_s2d")(x)
+        else:
+            x = _conv(64, 7, 2, self.dtype, name="stem_conv")(x)
+        x = _batch_norm(train, self.dtype, name="stem_bn", stats_dtype=self.stats_dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
@@ -163,6 +207,7 @@ class ResNet(nn.Module):
                     filters=64 * 2**stage,
                     strides=strides,
                     dtype=self.dtype,
+                    stats_dtype=self.stats_dtype,
                     name=f"stage{stage + 1}_block{b + 1}",
                 )(x, train=train)
 

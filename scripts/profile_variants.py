@@ -1,0 +1,83 @@
+"""Measure bytes/step + step time for ResNet50 perf variants on the chip.
+
+PROFILE.md byte-reduction roadmap experiments: baseline vs bf16 BN stats
+vs space-to-depth stem. Prints one line per variant with
+``cost_analysis()["bytes accessed"]`` and 20-step wall time.
+
+Usage: python scripts/profile_variants.py [variant ...]
+Variants: base bf16stats s2d both  (default: all)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.pipeline import shard_batch
+from distributeddeeplearning_tpu.models.resnet import ResNet
+from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+from distributeddeeplearning_tpu.training import (
+    create_optimizer,
+    create_train_state,
+    make_train_step,
+)
+from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+VARIANTS = {
+    "base": {},
+    "bf16stats": {"stats_dtype": jnp.bfloat16},
+    "s2d": {"s2d_stem": True},
+    "both": {"stats_dtype": jnp.bfloat16, "s2d_stem": True},
+}
+
+
+def run(name: str, batch_size: int = 256, steps: int = 20):
+    kw = VARIANTS[name]
+    cfg = TrainConfig(batch_size_per_device=batch_size)
+    model = ResNet(depth=50, num_classes=1000, dtype=jnp.bfloat16, **kw)
+    mesh = data_parallel_mesh(jax.device_count())
+    tx, _ = create_optimizer(cfg, steps_per_epoch=cfg.steps_per_epoch())
+    state = replicate_state(create_train_state(model, cfg, tx), mesh)
+    step = make_train_step(model, tx, mesh, cfg)
+
+    rng = np.random.RandomState(42)
+    n = batch_size * jax.device_count()
+    host = (
+        rng.uniform(-1, 1, size=(n, 224, 224, 3)).astype(ml_dtypes.bfloat16),
+        rng.randint(0, 1000, size=(n,)).astype(np.int32),
+    )
+    batch = shard_batch(host, mesh)
+
+    # AOT-compile once and drive the compiled executable directly (the
+    # jitted wrapper would compile the same program a second time).
+    compiled = step.lower(state, batch).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, list) else cost
+    gb = cost.get("bytes accessed", float("nan")) / 1e9
+
+    for _ in range(3):
+        state, metrics = compiled(state, batch)
+    float(metrics["loss"])  # fence
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled(state, batch)
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    ips = steps * n / dt
+    print(
+        f"{name:10s} bytes/step={gb:7.2f} GB  step={dt / steps * 1e3:6.1f} ms  "
+        f"img/s={ips:7.1f}  loss={loss:.4f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(VARIANTS)
+    for name in names:
+        run(name)

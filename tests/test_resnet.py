@@ -83,3 +83,19 @@ def test_bfloat16_compute_f32_params():
         assert leaf.dtype == jnp.float32
     out = model.apply(variables, x, train=False)
     assert out.dtype == jnp.float32
+
+
+def test_perf_knobs_bf16_stats_and_s2d_stem():
+    # PROFILE.md roadmap knobs (measured no-win on v5e but supported):
+    # bf16 statistics reduction + MLPerf space-to-depth stem.
+    model = ResNet(depth=18, num_classes=10, dtype=jnp.bfloat16,
+                   stats_dtype=jnp.bfloat16, s2d_stem=True)
+    variables, x = _init(model, size=64)
+    stem = variables["params"]["stem_conv_s2d"]["kernel"]
+    assert stem.shape == (4, 4, 12, 64)  # 112²×12 input, 2× fold into channels
+    out, mutated = model.apply(variables, jnp.asarray(x, jnp.bfloat16),
+                               train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 10) and out.dtype == jnp.float32
+    # running stats stay f32 regardless of the reduction dtype
+    for leaf in jax.tree.leaves(mutated["batch_stats"]):
+        assert leaf.dtype == jnp.float32
