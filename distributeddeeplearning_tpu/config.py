@@ -112,8 +112,16 @@ class TrainConfig:
     mesh_axes: Tuple[str, ...] = ("data",)
     # Training engine: "dp" = shard_map data-parallel (reference-parity
     # runtime); "pjit" = GSPMD engine consuming logical-axis annotations
-    # (tensor parallelism over a mesh with a "model" axis).
+    # (tensor parallelism over a mesh with a "model" axis); "pp" =
+    # pipeline parallelism (GPipe/1F1B over a "pipe" mesh axis, LM tier);
+    # "sp" = sequence parallelism (ring attention over a "seq" axis).
     engine: str = "dp"
+    # Pipeline-engine knobs (ENGINE=pp): stage count (None → the mesh's
+    # pipe axis, or all devices), microbatches per step, and the schedule
+    # ("gpipe" fill-drain | "1f1b" one-forward-one-backward).
+    pp_stages: Optional[int] = None
+    pp_microbatches: int = 4
+    pp_schedule: str = "gpipe"
     # Parameter-sharding rules for the pjit engine: "tp" (Megatron-style
     # over a 'model'/'expert' axis — the default), "fsdp" (ZeRO-3:
     # weights sharded over the data axis itself), "dp" (replicated).
@@ -141,10 +149,37 @@ class TrainConfig:
         return kw
 
     @property
-    def global_batch_size(self) -> int:
+    def data_parallel_width(self) -> int:
+        """How many batch shards the mesh carries. Under the dp/pjit
+        engines every device is a batch slot (reference semantics; the
+        pjit engine's TP axes still consume replicated batches). Under
+        pp/sp only the ``replica``/``data`` axes shard the batch — the
+        pipe/seq axes partition the model/sequence instead."""
         import jax
 
-        return self.batch_size_per_device * jax.device_count()
+        n = jax.device_count()
+        if self.engine not in ("pp", "sp"):
+            return n
+        if self.mesh_shape is not None:
+            from distributeddeeplearning_tpu.parallel.mesh import MeshConfig
+
+            shape = MeshConfig(
+                axes=tuple(self.mesh_axes), shape=tuple(self.mesh_shape)
+            ).resolve_shape(n)
+            width = 1
+            for axis, size in zip(self.mesh_axes, shape):
+                if axis in ("replica", "data"):
+                    width *= size
+            return width
+        # Engine-default meshes (loop.resolve_engine): pp puts PP_STAGES
+        # (or everything) on pipe; sp puts everything on seq.
+        if self.engine == "pp":
+            return n // (self.pp_stages or n)
+        return 1
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.batch_size_per_device * self.data_parallel_width
 
     def steps_per_epoch(self, data_length: Optional[int] = None) -> int:
         n = data_length if data_length is not None else self.fake_data_length
@@ -203,6 +238,12 @@ class TrainConfig:
             kw["decoupled_weight_decay"] = float(e["DECOUPLED_WEIGHT_DECAY"])
         if "ENGINE" in e:
             kw["engine"] = e["ENGINE"]
+        if "PP_STAGES" in e:
+            kw["pp_stages"] = int(e["PP_STAGES"])
+        if "PP_MICROBATCHES" in e:
+            kw["pp_microbatches"] = int(e["PP_MICROBATCHES"])
+        if "PP_SCHEDULE" in e:
+            kw["pp_schedule"] = e["PP_SCHEDULE"]
         if "PARAM_SHARDING" in e:
             kw["param_sharding"] = e["PARAM_SHARDING"]
         # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)

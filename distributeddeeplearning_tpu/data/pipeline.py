@@ -25,7 +25,7 @@ from distributeddeeplearning_tpu.parallel.mesh import batch_sharding
 PyTree = Any
 
 
-def shard_batch(batch: PyTree, mesh: Mesh, sharding: Optional[NamedSharding] = None) -> PyTree:
+def shard_batch(batch: PyTree, mesh: Mesh, sharding: Optional[PyTree] = None) -> PyTree:
     """Place a process-local numpy batch as a global, batch-sharded jax.Array.
 
     Single-process: a plain sharded ``device_put``. Multi-host: each process
@@ -33,12 +33,21 @@ def shard_batch(batch: PyTree, mesh: Mesh, sharding: Optional[NamedSharding] = N
     the mesh (``make_array_from_process_local_data`` — the moment the
     reference's per-rank ``DistributedSampler`` shards become one logical
     batch).
+
+    ``sharding`` may be a single ``NamedSharding`` (applied to every leaf)
+    or a pytree of shardings matching ``batch`` — the SP engine shards
+    2-D token arrays over ``(data, seq)`` but 1-D eval weights over
+    ``data`` only.
     """
-    sh = sharding or batch_sharding(mesh)
+    sh = sharding if sharding is not None else batch_sharding(mesh)
     if jax.process_count() == 1:
         return jax.device_put(batch, sh)
+    if isinstance(sh, jax.sharding.Sharding):
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sh, x), batch
+        )
     return jax.tree.map(
-        lambda x: jax.make_array_from_process_local_data(sh, x), batch
+        lambda x, s: jax.make_array_from_process_local_data(s, x), batch, sh
     )
 
 
@@ -56,10 +65,19 @@ def prefetch_to_device(
     pops fully-staged batches. Equivalent role to the reference's
     ``prefetch(256)`` (TF ``:258``) + pinned-memory DataLoader (PyTorch
     ``:313-316``).
+
+    ``sharding`` may also be a callable ``batch -> sharding`` (single or
+    pytree), resolved per batch — engines whose staging layout depends on
+    the batch arity (SP: eval weights shard differently) use this.
     """
+    stage = (
+        (lambda b: shard_batch(b, mesh, sharding(b)))
+        if callable(sharding)
+        else (lambda b: shard_batch(b, mesh, sharding))
+    )
     if size <= 0:
         for batch in it:
-            yield shard_batch(batch, mesh, sharding)
+            yield stage(batch)
         return
 
     q: "queue.Queue" = queue.Queue(maxsize=size)
@@ -79,7 +97,7 @@ def prefetch_to_device(
     def producer():
         try:
             for batch in it:
-                if not _put(shard_batch(batch, mesh, sharding)):
+                if not _put(stage(batch)):
                     return  # consumer gone: stop staging, free HBM refs
         except Exception as e:  # surfaced on the consumer side
             err.append(e)

@@ -28,12 +28,6 @@ from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device
 from distributeddeeplearning_tpu.training.optimizer import create_optimizer
 from distributeddeeplearning_tpu.training.state import TrainState
-from distributeddeeplearning_tpu.training.train_step import (
-    create_train_state,
-    make_eval_step,
-    make_train_step,
-    replicate_state,
-)
 from distributeddeeplearning_tpu.utils.logging import get_logger
 from distributeddeeplearning_tpu.utils.timer import Timer
 
@@ -49,6 +43,8 @@ class Pieces:
     train_step: Callable
     eval_step: Callable
     lr_schedule: optax.Schedule
+    # Per-batch staging-sharding resolver (None → default over `data`).
+    batch_sharding: Optional[Callable] = None
 
 
 def setup(
@@ -66,45 +62,30 @@ def setup(
     ``input_shape``/``input_dtype`` override the image init contract for
     non-image models (LM: ``(1, seq_len)``, ``jnp.int32``).
 
-    ``config.engine="pjit"`` builds the GSPMD pieces instead: state
-    sharded at birth per the logical rules, pjit train/eval steps."""
+    ``config.engine`` selects the runtime (dp / pjit / pp / sp) exactly
+    as in ``loop.fit`` — both route through
+    ``training.engines.build_engine``, the one dispatch point."""
+    from distributeddeeplearning_tpu.training.engines import build_engine
     from distributeddeeplearning_tpu.training.loop import resolve_engine
 
-    use_pjit, mesh = resolve_engine(config, mesh)
+    _, mesh = resolve_engine(config, mesh)
     spe = steps_per_epoch or config.steps_per_epoch()
     tx, schedule = create_optimizer(config, spe)
-    if use_pjit:
-        from distributeddeeplearning_tpu.training.pjit_step import (
-            build_pjit_state,
-            make_pjit_eval_step,
-            make_pjit_train_step,
-        )
-
-        state = build_pjit_state(
-            model, config, tx, mesh,
-            input_shape=input_shape, input_dtype=input_dtype,
-        )
-        train_step = make_pjit_train_step(model, tx, mesh, config)
-        eval_step = make_pjit_eval_step(model, mesh, config)
-    else:
-        state = replicate_state(
-            create_train_state(
-                model, config, tx, input_shape=input_shape, input_dtype=input_dtype
-            ),
-            mesh,
-        )
-        train_step = make_train_step(model, tx, mesh, config)
-        eval_step = make_eval_step(model, mesh)
+    eng = build_engine(
+        model, config, tx, mesh,
+        input_shape=input_shape, input_dtype=input_dtype,
+    )
     pieces = Pieces(
-        model=model,
+        model=eng.model,
         config=config,
         mesh=mesh,
         tx=tx,
-        train_step=train_step,
-        eval_step=eval_step,
+        train_step=eng.train_step,
+        eval_step=eng.eval_step,
         lr_schedule=schedule,
+        batch_sharding=eng.batch_sharding,
     )
-    return pieces, state
+    return pieces, eng.state
 
 
 def train_epoch(
@@ -121,7 +102,10 @@ def train_epoch(
     log_every = log_every if log_every is not None else cfg.log_every_steps
     timer = Timer().start()
     for i, batch in enumerate(
-        prefetch_to_device(data.epoch(epoch), pieces.mesh, size=cfg.prefetch_batches)
+        prefetch_to_device(
+            data.epoch(epoch), pieces.mesh, size=cfg.prefetch_batches,
+            sharding=pieces.batch_sharding,
+        )
     ):
         state, metrics = pieces.train_step(state, batch)
         if log_every and (i + 1) % log_every == 0:
@@ -137,4 +121,7 @@ def validate(pieces: Pieces, state: TrainState, data) -> Dict[str, float]:
     """Full-dataset eval (reference ``validate()`` :224-239)."""
     from distributeddeeplearning_tpu.training.loop import _run_eval
 
-    return _run_eval(pieces.eval_step, state, data, pieces.mesh, pieces.config)
+    return _run_eval(
+        pieces.eval_step, state, data, pieces.mesh, pieces.config,
+        sharding=pieces.batch_sharding,
+    )

@@ -136,8 +136,14 @@ class Model:
             from distributeddeeplearning_tpu.training.loop import resolve_engine
 
             tx, _ = create_optimizer(self.config, steps_per_epoch=1)
-            use_pjit, mesh = resolve_engine(self.config, self.mesh)
-            if use_pjit:
+            engine, mesh = resolve_engine(self.config, self.mesh)
+            if engine in ("pp", "sp"):
+                raise ValueError(
+                    "load_weights before fit() is not supported under "
+                    "ENGINE=pp/sp (the restore target needs the token "
+                    "signature) — call fit(resume=True) instead"
+                )
+            if engine == "pjit":
                 # Restore target must carry the TP shardings, or a later
                 # fit() would train with silently-replicated params.
                 from distributeddeeplearning_tpu.training.pjit_step import (

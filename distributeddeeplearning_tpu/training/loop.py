@@ -28,12 +28,6 @@ from distributeddeeplearning_tpu.training.callbacks import (
 from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
 from distributeddeeplearning_tpu.training.optimizer import create_optimizer
 from distributeddeeplearning_tpu.training.state import TrainState
-from distributeddeeplearning_tpu.training.train_step import (
-    create_train_state,
-    make_eval_step,
-    make_train_step,
-    replicate_state,
-)
 from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
 from distributeddeeplearning_tpu.utils.timer import Timer
 
@@ -57,13 +51,20 @@ class FitResult:
 
 def resolve_engine(config, mesh=None):
     """Validate ``config.engine`` and resolve the mesh (explicit arg wins;
-    else ``config.mesh_axes``/``mesh_shape``; else all-devices DP). One
+    else ``config.mesh_axes``/``mesh_shape``; else an engine-appropriate
+    default over all devices). Returns ``(engine_name, mesh)`` — one
     helper for every entry point so an unknown engine can never fall
     through to the wrong step."""
-    from distributeddeeplearning_tpu.parallel.mesh import mesh_from_config
+    from distributeddeeplearning_tpu.parallel.mesh import (
+        create_mesh,
+        mesh_from_config,
+    )
+    from distributeddeeplearning_tpu.training.engines import ENGINES
 
-    if config.engine not in ("dp", "pjit"):
-        raise ValueError(f"unknown engine {config.engine!r} (have dp, pjit)")
+    if config.engine not in ENGINES:
+        raise ValueError(
+            f"unknown engine {config.engine!r} (have {', '.join(ENGINES)})"
+        )
     # Validate the rules-table name eagerly (raises for unknown values),
     # and refuse a non-default PARAM_SHARDING under the dp engine — the
     # shard_map engine replicates params, so the user would silently NOT
@@ -71,15 +72,48 @@ def resolve_engine(config, mesh=None):
     from distributeddeeplearning_tpu.models.sharding import rules_table
 
     rules_table(config.param_sharding)
-    # Only "fsdp" is meaningless under the dp engine ("dp" rules =
-    # replicated params, which is exactly what the shard_map engine does).
+    # Only "fsdp" is meaningless under the shard_map engines ("dp" rules =
+    # replicated params, which is exactly what they do).
     if config.engine != "pjit" and config.param_sharding == "fsdp":
         raise ValueError(
             f"PARAM_SHARDING={config.param_sharding!r} requires ENGINE=pjit "
-            "(the dp engine keeps parameters replicated)"
+            f"(the {config.engine} engine keeps parameters replicated)"
         )
-    mesh = mesh if mesh is not None else mesh_from_config(config)
-    return config.engine == "pjit", mesh
+    if config.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown PP_SCHEDULE {config.pp_schedule!r} (have gpipe, 1f1b)"
+        )
+    if mesh is None:
+        # Engine-appropriate default topology when the user named an
+        # engine but no mesh at all: ENGINE=pp → (data, pipe) with
+        # PP_STAGES on pipe (all devices if unset); ENGINE=sp → all
+        # devices on seq. An explicit MESH_AXES/MESH_SHAPE always wins
+        # (and is validated below).
+        unset = config.mesh_shape is None and tuple(config.mesh_axes) == ("data",)
+        if config.engine == "pp" and unset:
+            stages = config.pp_stages or len(jax.devices())
+            mesh = create_mesh(axes=("data", "pipe"), shape=(-1, stages))
+        elif config.engine == "sp" and unset:
+            mesh = create_mesh(axes=("data", "seq"), shape=(1, -1))
+        else:
+            mesh = mesh_from_config(config)
+    if config.engine == "pp":
+        if "pipe" not in mesh.axis_names:
+            raise ValueError(
+                f"ENGINE=pp needs a 'pipe' mesh axis; got {mesh.axis_names} "
+                "(set MESH_AXES=data,pipe MESH_SHAPE=<dp>,<stages>)"
+            )
+        if config.pp_stages and mesh.shape["pipe"] != config.pp_stages:
+            raise ValueError(
+                f"PP_STAGES={config.pp_stages} != mesh pipe axis "
+                f"{mesh.shape['pipe']}"
+            )
+    if config.engine == "sp" and "seq" not in mesh.axis_names:
+        raise ValueError(
+            f"ENGINE=sp needs a 'seq' mesh axis; got {mesh.axis_names} "
+            "(set MESH_AXES=data,seq MESH_SHAPE=<dp>,<sp>)"
+        )
+    return config.engine, mesh
 
 
 def _init_spec(data):
@@ -120,30 +154,20 @@ def fit(
     averaged, Keras ``:344-353``), and prints the ``_log_summary`` block.
     """
     log = get_logger()
-    use_pjit, mesh = resolve_engine(config, mesh)
+    engine_name, mesh = resolve_engine(config, mesh)
     epochs = epochs if epochs is not None else config.epochs
     steps_per_epoch = train_data.steps_per_epoch
 
     if tx is None:
         tx, _ = create_optimizer(config, steps_per_epoch)
-    if state is None:
-        shape, dtype = _init_spec(train_data)
-        if use_pjit:
-            # Sharded-at-birth init: logical annotations (heads/mlp ->
-            # "model") map onto the mesh; unannotated models replicate.
-            from distributeddeeplearning_tpu.training.pjit_step import (
-                build_pjit_state,
-            )
+    from distributeddeeplearning_tpu.training.engines import build_engine
 
-            state = build_pjit_state(
-                model, config, tx, mesh, input_shape=shape, input_dtype=dtype
-            )
-        else:
-            state = create_train_state(
-                model, config, tx, input_shape=shape, input_dtype=dtype
-            )
-    if not use_pjit:
-        state = replicate_state(state, mesh)
+    shape, dtype = _init_spec(train_data)
+    eng = build_engine(
+        model, config, tx, mesh,
+        input_shape=shape, input_dtype=dtype, state=state,
+    )
+    state, model = eng.state, eng.model
 
     from distributeddeeplearning_tpu.training.callbacks import (
         ModelCheckpointCallback,
@@ -189,21 +213,8 @@ def fit(
         if start_epoch:
             log.info("resuming from epoch %d", start_epoch)
 
-    if use_pjit:
-        from distributeddeeplearning_tpu.training.pjit_step import (
-            make_pjit_eval_step,
-            make_pjit_train_step,
-        )
-
-        train_step = make_pjit_train_step(model, tx, mesh, config)
-        eval_step = (
-            make_pjit_eval_step(model, mesh, config)
-            if eval_data is not None
-            else None
-        )
-    else:
-        train_step = make_train_step(model, tx, mesh, config)
-        eval_step = make_eval_step(model, mesh) if eval_data is not None else None
+    train_step = eng.train_step
+    eval_step = eng.eval_step if eval_data is not None else None
 
     history: List[Dict[str, float]] = []
     global_batch = config.global_batch_size
@@ -216,7 +227,8 @@ def fit(
         callback_list.on_epoch_begin(epoch)
         step_in_epoch = 0
         for batch in prefetch_to_device(
-            train_data.epoch(epoch), mesh, size=config.prefetch_batches
+            train_data.epoch(epoch), mesh, size=config.prefetch_batches,
+            sharding=eng.batch_sharding,
         ):
             state, metrics = train_step(state, batch)
             step_in_epoch += 1
@@ -236,7 +248,10 @@ def fit(
         epoch_logs["epoch_images"] = epoch_images
 
         if eval_step is not None and eval_data is not None and config.validation:
-            eval_metrics = _run_eval(eval_step, state, eval_data, mesh, config)
+            eval_metrics = _run_eval(
+                eval_step, state, eval_data, mesh, config,
+                sharding=eng.batch_sharding,
+            )
             epoch_logs.update({f"val_{k}": v for k, v in eval_metrics.items()})
 
         history.append({k: v for k, v in epoch_logs.items() if k != "state"})
@@ -260,14 +275,17 @@ def fit(
     return FitResult(state=state, history=history, images_per_sec=images_per_sec)
 
 
-def _run_eval(eval_step, state, eval_data, mesh, config) -> Dict[str, float]:
+def _run_eval(
+    eval_step, state, eval_data, mesh, config, sharding=None
+) -> Dict[str, float]:
     """Sample-exact evaluation: each batch's means are re-weighted by its
     real-sample ``count``, so padded tail batches (exact-coverage datasets)
     and full batches combine into metrics over exactly the dataset."""
     totals: Dict[str, float] = {}
     samples = 0.0
     for batch in prefetch_to_device(
-        eval_data.epoch(0), mesh, size=config.prefetch_batches
+        eval_data.epoch(0), mesh, size=config.prefetch_batches,
+        sharding=sharding,
     ):
         m = {k: float(jax.device_get(v)) for k, v in eval_step(state, batch).items()}
         count = m.pop("count", None)
@@ -294,13 +312,8 @@ def evaluate(
     Dispatches on ``config.engine`` like ``fit`` — a TP-sharded state
     must not pass through the shard_map step's replicated in_spec (it
     would all-gather the params on every device)."""
-    use_pjit, mesh = resolve_engine(config, mesh)
-    if use_pjit:
-        from distributeddeeplearning_tpu.training.pjit_step import (
-            make_pjit_eval_step,
-        )
+    from distributeddeeplearning_tpu.training.engines import build_eval_step
 
-        eval_step = make_pjit_eval_step(model, mesh, config)
-    else:
-        eval_step = make_eval_step(model, mesh)
-    return _run_eval(eval_step, state, eval_data, mesh, config)
+    _, mesh = resolve_engine(config, mesh)
+    _, eval_step, sharding = build_eval_step(model, config, mesh)
+    return _run_eval(eval_step, state, eval_data, mesh, config, sharding=sharding)

@@ -104,9 +104,25 @@ def make_pp_train_step(
     config: Optional[TrainConfig] = None,
     *,
     num_microbatches: int = 4,
+    schedule: str = "gpipe",
     donate_state: bool = True,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
-    """Compiled PP (×DP) train step over a mesh with a ``pipe`` axis."""
+    """Compiled PP (×DP) train step over a mesh with a ``pipe`` axis.
+
+    ``schedule``: ``"gpipe"`` (fill-drain; AD transposes the forward
+    scan, so every microbatch's activations stay live through backward)
+    or ``"1f1b"`` (one-forward-one-backward: hand-scheduled per-tick
+    vjp with a 2S-deep input ring buffer — activation memory bounded by
+    the stage count instead of the microbatch count; recomputes each
+    stage forward once during its backward tick, remat-style).
+    """
+    if schedule == "1f1b":
+        return _make_pp_train_step_1f1b(
+            pl, tx, mesh, config,
+            num_microbatches=num_microbatches, donate_state=donate_state,
+        )
+    if schedule != "gpipe":
+        raise ValueError(f"unknown PP schedule {schedule!r} (gpipe, 1f1b)")
     cfg = config or TrainConfig()
     if PIPE_AXIS not in mesh.axis_names:
         raise ValueError(f"mesh {mesh.axis_names} has no '{PIPE_AXIS}' axis")
@@ -289,6 +305,282 @@ def make_pp_train_step(
             _cache[key] = build(state)
         return _cache[key](state, batch)
 
+    step.build = build  # AOT access (scripts/pp_schedule_bench.py)
+    return step
+
+
+def _l2_grad_tree(tree: PyTree, weight_decay: float) -> PyTree:
+    """Analytic gradient of ``l2_kernel_penalty``: 2·wd·kernel on kernel
+    leaves, zeros elsewhere (the 1F1B schedule computes grads by explicit
+    vjp, so the L2 term is added in closed form)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, v: (
+            (2.0 * weight_decay * v.astype(jnp.float32)).astype(v.dtype)
+            if path and getattr(path[-1], "key", None) == "kernel"
+            else jnp.zeros_like(v)
+        ),
+        tree,
+    )
+
+
+def _make_pp_train_step_1f1b(
+    pl: PipelineLM,
+    tx,
+    mesh: Mesh,
+    config: Optional[TrainConfig] = None,
+    *,
+    num_microbatches: int = 4,
+    donate_state: bool = True,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """1F1B (one-forward-one-backward) pipeline schedule.
+
+    Where GPipe lets AD transpose the forward scan (every microbatch's
+    stage activations stay live from its forward tick until its backward
+    tick — O(M) activation memory), 1F1B hand-schedules backward: each
+    tick every stage runs ONE microbatch forward and ONE explicit
+    ``jax.vjp`` backward of an earlier microbatch, keeping only a
+    ``2S``-slot ring buffer of stage *inputs* (the stage forward is
+    recomputed inside its backward tick, remat-style — same FLOP count
+    as a remat'd GPipe).
+
+    Tick schedule (uniform over devices — every tick does both halves,
+    validity-masked): with ``t ∈ [0, M + 2S − 1)``,
+
+    * forward of microbatch ``m_f = t − s`` at stage ``s``;
+    * backward of microbatch ``m_b = t − S − (S−1−s)`` at stage ``s``
+      (gradients hop ``s+1 → s`` on the reverse ``ppermute`` each tick).
+
+    A microbatch's input is saved at tick ``m+s`` and consumed at tick
+    ``S + m + (S−1−s)`` — a gap of ``2(S−s)−1 < 2S`` ticks, so the ring
+    buffer never overwrites a live slot. Loss/optimizer/metric semantics
+    are identical to the GPipe step (same objective, same collectives);
+    the exact-equality oracle in ``tests/test_pp_step.py`` covers both.
+    """
+    cfg = config or TrainConfig()
+    if PIPE_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no '{PIPE_AXIS}' axis")
+    S = mesh.shape[PIPE_AXIS]
+    if S != pl.num_stages:
+        raise ValueError(f"mesh pipe={S} != model num_stages={pl.num_stages}")
+    data_axes = _data_axes(mesh)
+    d_axis = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)
+    all_axes = tuple(data_axes) + (PIPE_AXIS,)
+    M = num_microbatches
+    K = 2 * S  # ring-buffer depth (max in-flight gap is 2S-1 ticks)
+    embed, core, head = pl.modules()
+    base_rng = jax.random.PRNGKey(cfg.seed)
+
+    def local_step(state: TrainState, batch: Batch):
+        tokens, labels = batch
+        s_idx = lax.axis_index(PIPE_AXIS)
+        is_last = s_idx == S - 1
+        b_l, t_len = tokens.shape
+        if b_l % M:
+            raise ValueError(f"local batch {b_l} not divisible by {M} microbatches")
+        mb = b_l // M
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            flat_axis_index(mesh, all_axes),
+        )
+
+        def vary(tree, axes):
+            if not axes:
+                return tree
+            ax = axes if len(axes) > 1 else axes[0]
+            return jax.tree.map(lambda p: lax.pcast(p, ax, to="varying"), tree)
+
+        params_v = {
+            "embed": vary(state.params["embed"], all_axes),
+            "stages": vary(state.params["stages"], data_axes),
+            "head": vary(state.params["head"], all_axes),
+        }
+        stage_p = jax.tree.map(lambda a: a[0], params_v["stages"])
+
+        # Embedding forward under vjp — its backward runs after the scan
+        # on the accumulated stage-0 input gradients.
+        x_all, embed_vjp = jax.vjp(
+            lambda pe: embed.apply({"params": pe}, tokens), params_v["embed"]
+        )
+        hidden = x_all.shape[-1]
+        xm = x_all.reshape(M, mb, t_len, hidden)
+        lm = labels.reshape(M, mb, t_len)
+
+        def core_fn(p, x, m):
+            rngs = {
+                "dropout": jax.random.fold_in(dropout_rng, jnp.clip(m, 0, M - 1))
+            }
+            return core.apply({"params": p}, x, train=True, rngs=rngs)
+
+        def head_loss_fn(ph, y, labels_m):
+            logits = head.apply({"params": ph}, y)
+            ce = cross_entropy_loss(logits, labels_m, cfg.label_smoothing)
+            return ce, logits
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, saved, sgrad, hgrad, dx_all, ce_sum, acc_sum = carry
+
+            # ---- forward half: microbatch m_f through this stage ----
+            m_f = t - s_idx
+            inject = lax.dynamic_index_in_dim(
+                xm, jnp.clip(m_f, 0, M - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(s_idx == 0, inject, fwd_buf)
+            saved = lax.dynamic_update_index_in_dim(saved, x_in, t % K, 0)
+            y = core_fn(stage_p, x_in, m_f)
+
+            # ---- backward half: explicit vjp of microbatch m_b ----
+            m_b = t - S - (S - 1 - s_idx)
+            valid_b = (m_b >= 0) & (m_b < M)
+            x_saved = lax.dynamic_index_in_dim(
+                saved, (jnp.clip(m_b, 0, M - 1) + s_idx) % K, 0, keepdims=False
+            )
+            y_rec, vjp_core = jax.vjp(
+                lambda p, x: core_fn(p, x, m_b), stage_p, x_saved
+            )
+            labels_m = lax.dynamic_index_in_dim(
+                lm, jnp.clip(m_b, 0, M - 1), 0, keepdims=False
+            )
+            ce_m, hl_vjp, logits = jax.vjp(
+                lambda ph, y_: head_loss_fn(ph, y_, labels_m),
+                params_v["head"], y_rec, has_aux=True,
+            )
+            # d(total)/d(ce_m) = 1/M: total loss is the mean over
+            # microbatches of per-microbatch mean CE (equal sizes). The
+            # seed must carry the output's varying axes.
+            dhead_m, dy_head = hl_vjp(
+                lax.pcast(jnp.float32(1.0 / M), all_axes, to="varying")
+            )
+            dy_in = jnp.where(is_last, dy_head, bwd_buf)
+            dstage_m, dx_m = vjp_core(dy_in)
+
+            keep = lambda g: jnp.where(valid_b, g, jnp.zeros_like(g))
+            sgrad = jax.tree.map(lambda a, g: a + keep(g), sgrad, dstage_m)
+            hgrad = jax.tree.map(
+                lambda a, g: a + jnp.where(valid_b & is_last, g, jnp.zeros_like(g)),
+                hgrad, dhead_m,
+            )
+            dx_upd = lax.dynamic_update_index_in_dim(
+                dx_all, dx_m, jnp.clip(m_b, 0, M - 1), 0
+            )
+            dx_all = jnp.where(valid_b & (s_idx == 0), dx_upd, dx_all)
+            acc_m = jnp.mean(
+                (jnp.argmax(logits, -1) == labels_m).astype(jnp.float32)
+            )
+            live_last = valid_b & is_last
+            ce_sum = ce_sum + jnp.where(live_last, ce_m, 0.0) / M
+            acc_sum = acc_sum + jnp.where(live_last, acc_m, 0.0) / M
+
+            # ---- hops: activations s→s+1, gradients s+1→s ----
+            if S > 1:
+                fwd_buf = lax.ppermute(
+                    y, PIPE_AXIS, [(j, j + 1) for j in range(S - 1)]
+                )
+                bwd_buf = lax.ppermute(
+                    keep(dx_m), PIPE_AXIS, [(j + 1, j) for j in range(S - 1)]
+                )
+            else:
+                fwd_buf, bwd_buf = y, jnp.zeros_like(bwd_buf)
+            return (fwd_buf, bwd_buf, saved, sgrad, hgrad, dx_all, ce_sum, acc_sum), None
+
+        def var0(x):
+            return lax.pcast(x, all_axes, to="varying")
+
+        carry0 = (
+            var0(jnp.zeros((mb, t_len, hidden), x_all.dtype)),
+            var0(jnp.zeros((mb, t_len, hidden), x_all.dtype)),
+            var0(jnp.zeros((K, mb, t_len, hidden), x_all.dtype)),
+            # zeros_like inherits the params' varying axes — no pcast
+            jax.tree.map(jnp.zeros_like, stage_p),
+            jax.tree.map(jnp.zeros_like, params_v["head"]),
+            var0(jnp.zeros((M, mb, t_len, hidden), x_all.dtype)),
+            var0(jnp.zeros((), jnp.float32)),
+            var0(jnp.zeros((), jnp.float32)),
+        )
+        (_, _, _, sgrad, hgrad, dx_all, ce_sum, acc_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(M + 2 * S - 1)
+        )
+
+        # Embedding backward + cross-stage reductions (zeros off-owner).
+        (dembed,) = embed_vjp(dx_all.reshape(b_l, t_len, hidden))
+        grads = {
+            "embed": jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), dembed),
+            # restore the leading [1, ...] local-shard stage axis
+            "stages": jax.tree.map(lambda g: g[None], sgrad),
+            "head": jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), hgrad),
+        }
+        # L2 objective term, in closed form (same masked-psum semantics
+        # as the GPipe step's AD: embed/head counted once, stages
+        # per-device). Embed/head terms derive from the *invariant*
+        # replicated params so the summed grads stay pipe-invariant like
+        # the psum'd schedule grads above.
+        l2g = {
+            "embed": _l2_grad_tree(state.params["embed"], cfg.weight_decay),
+            "stages": jax.tree.map(
+                lambda g: g[None], _l2_grad_tree(stage_p, cfg.weight_decay)
+            ),
+            "head": _l2_grad_tree(state.params["head"], cfg.weight_decay),
+        }
+        grads = jax.tree.map(lambda a, b: a + b, grads, l2g)
+        l2_eh = l2_kernel_penalty(
+            {"embed": params_v["embed"], "head": params_v["head"]},
+            cfg.weight_decay,
+        )
+        l2_val = lax.psum(
+            jnp.where(s_idx == 0, l2_eh, 0.0)
+            + l2_kernel_penalty(params_v["stages"], cfg.weight_decay),
+            PIPE_AXIS,
+        )
+        loss = lax.psum(ce_sum, PIPE_AXIS) + l2_val
+        accuracy = lax.psum(acc_sum, PIPE_AXIS)
+
+        if d_axis is not None:
+            grads = lax.pmean(grads, d_axis)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+
+        def sq(tree):
+            return sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(tree)
+            )
+
+        gn2 = sq(grads["embed"]) + sq(grads["head"]) + lax.psum(
+            sq(grads["stages"]), PIPE_AXIS
+        )
+        metrics = {"loss": loss, "accuracy": accuracy, "grad_norm": jnp.sqrt(gn2)}
+        if d_axis is not None:
+            metrics = lax.pmean(metrics, d_axis)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=state.batch_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    def build(state: TrainState):
+        specs = pp_state_specs(state)
+        batch_spec = P(d_axis) if d_axis is not None else P()
+        return jax.jit(
+            jax.shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=(specs, (batch_spec, batch_spec)),
+                out_specs=(specs, P()),
+            ),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    _cache = {}
+
+    def step(state: TrainState, batch: Batch):
+        key = jax.tree_util.tree_structure(state)
+        if key not in _cache:
+            _cache[key] = build(state)
+        return _cache[key](state, batch)
+
+    step.build = build  # AOT access (scripts/pp_schedule_bench.py)
     return step
 
 

@@ -33,9 +33,11 @@ def create_lr_schedule(
     world_size (reference LR rule, BASELINE.md).
     """
     if world_size is None:
-        import jax
-
-        world_size = jax.device_count()
+        # The linear-scaling rule (arXiv:1706.02677) tracks the GLOBAL
+        # BATCH, i.e. the number of batch shards: all devices under
+        # dp/pjit, the data axis only under pp/sp (pipe/seq devices
+        # partition the model/sequence, not the batch).
+        world_size = config.data_parallel_width
     peak = config.base_lr * (world_size if config.scale_lr_by_world_size else 1)
     warmup_steps = config.warmup_epochs * steps_per_epoch
 

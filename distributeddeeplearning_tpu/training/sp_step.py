@@ -36,6 +36,7 @@ from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.training.state import TrainState
 from distributeddeeplearning_tpu.training.train_step import (
     cross_entropy_loss,
+    eval_metrics_fn,
     flat_axis_index,
     l2_kernel_penalty,
     sown_aux_loss,
@@ -142,3 +143,65 @@ def make_sp_train_step(
         out_specs=(P(), P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+
+
+def make_sp_eval_step(
+    model,
+    mesh: Mesh,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable[[TrainState, Any], Dict[str, jnp.ndarray]]:
+    """Compiled DP×SP eval step with the engines' exact-coverage weighted
+    metric contract (``train_step.eval_metrics_fn``): ``weights`` ∈ {0,1}
+    mask padded samples; every real token counts exactly once.
+
+    Tokens/labels arrive sharded over ``(data, seq)``; the per-sample
+    ``weights`` vector is sharded over ``data`` only (replicated across
+    ``seq`` — each sequence shard applies its sample's weight to its own
+    tokens, and the two-axis psum sums every global token once)."""
+    if getattr(model, "attn_impl", None) != "ring":
+        raise ValueError(
+            f"model.attn_impl={getattr(model, 'attn_impl', None)!r}: "
+            "sequence-parallel eval requires attn_impl='ring'"
+        )
+    axes = (data_axis, seq_axis)
+
+    def local_eval(state: TrainState, batch):
+        tokens, labels, weights = batch
+        # weights arrive varying over `data` only (replicated across the
+        # sequence shards); the two-axis psum needs uniform vma.
+        weights = lax.pcast(weights, seq_axis, to="varying")
+        logits = model.apply({"params": state.params}, tokens, train=False)
+        sums = lax.psum(eval_metrics_fn(logits, labels, weights), axes)
+        count = sums.pop("count")
+        safe = jnp.maximum(count, 1.0)
+        out = {k: v / safe for k, v in sums.items()}
+        out["count"] = count
+        return out
+
+    spec = P(data_axis, seq_axis)
+    sharded = jax.jit(
+        jax.shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=(P(), (spec, spec, P(data_axis))),
+            out_specs=P(),
+        )
+    )
+
+    def step(state: TrainState, batch):
+        if len(batch) == 2:
+            # Convenience (single-host tests): all samples real — same
+            # contract as train_step.make_eval_step.
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "multi-host eval requires (tokens, labels, weights) "
+                    "batches — use an exact-eval dataset (train=False)"
+                )
+            tokens, labels = batch
+            weights = jnp.ones(labels.shape[:1], jnp.float32)
+            batch = (tokens, labels, weights)
+        return sharded(state, batch)
+
+    return step

@@ -1,0 +1,98 @@
+"""GPipe vs 1F1B: measured step time + compiled memory (VERDICT r2 #3).
+
+Runs both schedules on the 8-device forced-CPU mesh (S=4 stages x 2-way
+DP, M=8 microbatches, lm_tiny) and prints wall-clock per step plus XLA's
+``memory_analysis`` (argument/output/temp/generated-code bytes — temp
+size is where the schedules differ: GPipe's AD keeps every microbatch's
+stage activations live; 1F1B's ring buffer holds 2S stage inputs).
+
+Usage: python scripts/pp_schedule_bench.py [stages] [microbatches]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.models.pipeline_lm import PipelineLM
+from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+from distributeddeeplearning_tpu.training.pp_step import (
+    create_pp_state,
+    make_pp_train_step,
+)
+
+VOCAB, T, LAYERS_PER_STAGE = 256, 128, 2
+
+
+def run(schedule: str, stages: int, microbatches: int, steps: int = 10):
+    n_dev = len(jax.devices())
+    data_par = n_dev // stages
+    pl = PipelineLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=T, num_stages=stages,
+        n_layers=stages * LAYERS_PER_STAGE, dtype=jnp.float32,
+    )
+    cfg = TrainConfig(
+        num_classes=VOCAB, batch_size_per_device=microbatches,
+        weight_decay=0.0, compute_dtype="float32",
+    )
+    mesh = create_mesh(axes=("data", "pipe"), shape=(data_par, stages))
+    tx = optax.sgd(0.01)
+    state = create_pp_state(pl, cfg, tx, mesh, T)
+    step = make_pp_train_step(
+        pl, tx, mesh, cfg, num_microbatches=microbatches, schedule=schedule,
+        donate_state=False,
+    )
+    rows = np.random.RandomState(0).randint(
+        0, VOCAB, size=(microbatches * data_par, T + 1)
+    ).astype(np.int32)
+    spec = NamedSharding(mesh, P("data"))
+    batch = (
+        jax.device_put(rows[:, :-1], spec),
+        jax.device_put(rows[:, 1:], spec),
+    )
+    # One AOT compile serves both memory_analysis and the timing loop
+    # (calling the jitted wrapper would compile the program a second time).
+    compiled = step.build(state).lower(state, batch).compile()
+    try:
+        mem = compiled.memory_analysis()
+        temp_mb = mem.temp_size_in_bytes / 1e6
+    except Exception:
+        temp_mb = float("nan")
+
+    state, metrics = compiled(state, batch)  # warmup
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled(state, batch)
+    loss = float(metrics["loss"])  # fence
+    dt = (time.perf_counter() - t0) / steps
+    print(
+        f"{schedule:6s} S={stages} M={microbatches}: "
+        f"step={dt * 1e3:8.1f} ms  temp={temp_mb:10.1f} MB  loss={loss:.4f}",
+        flush=True,
+    )
+    return dt
+
+
+def main():
+    stages = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    microbatches = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    for schedule in ("gpipe", "1f1b"):
+        run(schedule, stages, microbatches)
+
+
+if __name__ == "__main__":
+    main()

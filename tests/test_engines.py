@@ -1,0 +1,211 @@
+"""ENGINE=pp / ENGINE=sp as first-class members of the one-engine
+contract (SURVEY §1 env-var boundary, §7 "3 API styles over one
+runtime"): reachable from ``loop.fit`` and the front-ends with
+eval, callbacks, and checkpoint/resume — not library-only paths.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.synthetic import SyntheticTokenDataset
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.training import loop
+
+VOCAB, T = 64, 16
+
+
+def _cfg(engine, **kw):
+    base = dict(
+        engine=engine,
+        model="lm_tiny",
+        num_classes=VOCAB,
+        batch_size_per_device=2,
+        fake_data_length=64,
+        epochs=1,
+        compute_dtype="float32",
+        weight_decay=0.0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _model():
+    return get_model("lm_tiny", num_classes=VOCAB, dtype="float32", max_seq_len=T)
+
+
+def _data(cfg, length=None, seed=0):
+    return SyntheticTokenDataset(
+        length=length or cfg.fake_data_length,
+        global_batch_size=cfg.global_batch_size,
+        seq_len=T,
+        vocab_size=VOCAB,
+        seed=seed,
+    )
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_engine_pp_fit_trains_and_evals(devices, schedule):
+    cfg = _cfg(
+        "pp", mesh_axes=("data", "pipe"), mesh_shape=(2, 4),
+        pp_microbatches=2, pp_schedule=schedule, validation=True,
+    )
+    assert cfg.global_batch_size == 4  # 2 per device x 2-wide data axis
+    res = loop.fit(
+        _model(), cfg, _data(cfg), eval_data=_data(cfg, length=32, seed=1),
+        add_default_logger=False,
+    )
+    assert int(jax.device_get(res.state.step)) == _data(cfg).steps_per_epoch
+    assert np.isfinite(res.history[-1]["loss"])
+    assert np.isfinite(res.history[-1]["val_loss"])
+    # the state really is stage-partitioned
+    leaf = jax.tree.leaves(res.state.params["stages"])[0]
+    assert leaf.shape[0] == 4 and tuple(leaf.sharding.spec)[:1] == ("pipe",)
+
+
+def test_engine_sp_fit_trains_and_evals(devices):
+    cfg = _cfg(
+        "sp", mesh_axes=("data", "seq"), mesh_shape=(2, 4), validation=True
+    )
+    assert cfg.global_batch_size == 4
+    res = loop.fit(
+        _model(), cfg, _data(cfg), eval_data=_data(cfg, length=32, seed=1),
+        add_default_logger=False,
+    )
+    assert np.isfinite(res.history[-1]["loss"])
+    assert np.isfinite(res.history[-1]["val_loss"])
+
+
+def test_engine_pp_checkpoint_resume(devices, tmp_path):
+    cfg = _cfg(
+        "pp", mesh_axes=("data", "pipe"), mesh_shape=(2, 4),
+        pp_microbatches=2, epochs=1, model_dir=str(tmp_path),
+    )
+    data = _data(cfg)
+    res1 = loop.fit(_model(), cfg, data, add_default_logger=False)
+    # Second fit with epochs=2 resumes from the saved epoch-0 checkpoint:
+    # only one more epoch of steps runs, on the restored sharded state.
+    res2 = loop.fit(
+        _model(), cfg.replace(epochs=2), data, add_default_logger=False
+    )
+    assert int(jax.device_get(res2.state.step)) == 2 * data.steps_per_epoch
+    assert len(res2.history) == 1  # epoch 0 skipped via resume
+
+
+def test_engine_sp_matches_dp_loss_curve(devices):
+    """SP over (1, 8) must reproduce plain DP single-batch training: the
+    strategies differ only in layout, not math (ring == full attention)."""
+    data_kw = dict(length=32, seq_len=T, vocab_size=VOCAB, seed=0)
+    sp_cfg = _cfg(
+        "sp", mesh_axes=("data", "seq"), mesh_shape=(1, 8),
+        scale_lr_by_world_size=False,
+    )
+    sp_data = SyntheticTokenDataset(global_batch_size=4, **data_kw)
+    sp_res = loop.fit(_model(), sp_cfg, sp_data, add_default_logger=False)
+
+    dp_cfg = _cfg(
+        "dp", batch_size_per_device=1, scale_lr_by_world_size=False
+    )
+    # match global batch exactly: 4 rows over the 8-device data mesh is
+    # not expressible; use a 4-device data mesh instead.
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+
+    dp_mesh = create_mesh(devices=jax.devices()[:4], axes=("data",))
+    dp_data = SyntheticTokenDataset(global_batch_size=4, **data_kw)
+    dp_res = loop.fit(
+        _model(), dp_cfg, dp_data, mesh=dp_mesh, add_default_logger=False
+    )
+    np.testing.assert_allclose(
+        sp_res.history[-1]["loss"], dp_res.history[-1]["loss"],
+        rtol=2e-4,
+    )
+
+
+def test_engine_pp_explicit_frontend(devices):
+    """The lm_synthetic_tpu example path: explicit.setup under ENGINE=pp."""
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.frontends import explicit
+
+    cfg = _cfg(
+        "pp", mesh_axes=("data", "pipe"), mesh_shape=(2, 4), pp_microbatches=2
+    )
+    data = _data(cfg)
+    pieces, state = explicit.setup(
+        _model(), cfg, steps_per_epoch=data.steps_per_epoch,
+        input_shape=(1, T), input_dtype=jnp.int32,
+    )
+    state = explicit.train_epoch(pieces, state, data, 0, log_every=0)
+    assert int(jax.device_get(state.step)) == data.steps_per_epoch
+    metrics = explicit.validate(pieces, state, _data(cfg, length=32, seed=1))
+    # token-model eval counts tokens (32 rows x T), like the dp engine
+    assert np.isfinite(metrics["loss"]) and metrics["samples"] == 32 * T
+
+
+def test_engine_sp_keras_frontend(devices):
+    from distributeddeeplearning_tpu.frontends.keras_style import Model
+
+    cfg = _cfg("sp", mesh_axes=("data", "seq"), mesh_shape=(2, 4))
+    m = Model(_model(), config=cfg).compile(optimizer="sgd")
+    result = m.fit(_data(cfg), epochs=1)
+    assert np.isfinite(result.history[-1]["loss"])
+
+
+def test_resolve_engine_validation(devices):
+    from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+    # pp without a pipe axis in an explicit mesh
+    with pytest.raises(ValueError, match="pipe"):
+        resolve_engine(_cfg("pp", mesh_axes=("data", "model"), mesh_shape=(2, 4)))
+    with pytest.raises(ValueError, match="seq"):
+        resolve_engine(_cfg("sp", mesh_axes=("data",), mesh_shape=(8,)))
+    with pytest.raises(ValueError, match="PP_STAGES"):
+        resolve_engine(
+            _cfg("pp", mesh_axes=("data", "pipe"), mesh_shape=(2, 4), pp_stages=2)
+        )
+    with pytest.raises(ValueError, match="PP_SCHEDULE"):
+        resolve_engine(_cfg("pp", pp_schedule="interleaved"))
+    # engine-default meshes when only ENGINE is set
+    engine, mesh = resolve_engine(_cfg("pp", pp_stages=4))
+    assert engine == "pp" and mesh.shape == {"data": 2, "pipe": 4}
+    engine, mesh = resolve_engine(_cfg("sp"))
+    assert engine == "sp" and mesh.shape == {"data": 1, "seq": 8}
+
+
+def test_pp_env_contract(devices):
+    env = {
+        "ENGINE": "pp",
+        "PP_STAGES": "4",
+        "PP_MICROBATCHES": "8",
+        "PP_SCHEDULE": "1f1b",
+        "MESH_AXES": "data,pipe",
+        "MESH_SHAPE": "2,4",
+    }
+    cfg = TrainConfig.from_env(env)
+    assert cfg.engine == "pp" and cfg.pp_stages == 4
+    assert cfg.pp_microbatches == 8 and cfg.pp_schedule == "1f1b"
+    assert cfg.data_parallel_width == 2
+    sp = TrainConfig.from_env({"ENGINE": "sp", "MESH_AXES": "data,seq",
+                               "MESH_SHAPE": "4,2"})
+    assert sp.data_parallel_width == 4
+
+
+def test_adapt_model_errors(devices):
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+    from distributeddeeplearning_tpu.training.engines import adapt_model
+
+    mesh = create_mesh(axes=("data", "pipe"), shape=(2, 4))
+    vision = get_model("resnet18", num_classes=10)
+    with pytest.raises(ValueError, match="LM family"):
+        adapt_model(vision, "pp", mesh, _cfg("pp"))
+    with pytest.raises(ValueError, match="attn_impl"):
+        adapt_model(vision, "sp", mesh, _cfg("sp"))
+    moe = get_model("lm_moe_tiny", num_classes=VOCAB, max_seq_len=T)
+    with pytest.raises(ValueError, match="dense"):
+        adapt_model(moe, "pp", mesh, _cfg("pp"))
+    # sp adaptation rebuilds the model with ring attention
+    adapted = adapt_model(_model(), "sp", mesh, _cfg("sp"))
+    assert adapted.attn_impl == "ring" and adapted.seq_axis == "seq"

@@ -146,5 +146,5 @@ def test_top_p_validation_and_dp_rules_allowed():
     from distributeddeeplearning_tpu.config import TrainConfig
     from distributeddeeplearning_tpu.training.loop import resolve_engine
 
-    use_pjit, _ = resolve_engine(TrainConfig(engine="dp", param_sharding="dp"))
-    assert not use_pjit
+    engine, _ = resolve_engine(TrainConfig(engine="dp", param_sharding="dp"))
+    assert engine == "dp"

@@ -267,8 +267,8 @@ def test_engine_validation_and_config_mesh(devices):
     cfg = CFG.replace(
         engine="pjit", mesh_axes=("data", "model"), mesh_shape=(2, 4)
     )
-    use_pjit, mesh = resolve_engine(cfg)
-    assert use_pjit and mesh.shape == {"data": 2, "model": 4}
+    engine, mesh = resolve_engine(cfg)
+    assert engine == "pjit" and mesh.shape == {"data": 2, "model": 4}
     # annotated model on a mesh without a 'model' axis: the rules project
     # onto the mesh (models/sharding.rules_for_mesh) — params degrade to
     # replicated and the run is plain DP, not an error. One rules table

@@ -180,3 +180,82 @@ def test_pp_validation_errors(pp_mesh):
     mesh_nopipe = create_mesh(devices=jax.devices())
     with pytest.raises(ValueError, match="pipe"):
         make_pp_train_step(pl4, tx, mesh_nopipe, CFG)
+
+
+def test_pp_1f1b_matches_sequential_reference(pp_mesh):
+    """The 1F1B schedule (hand-scheduled per-tick vjp, 2S-slot input ring
+    buffer) computes the identical update to the sequential oracle — and
+    therefore to the GPipe schedule."""
+    pl = _pl()
+    tx = optax.sgd(0.1, momentum=0.9)
+    rows = _rows(8)
+    tokens, labels = rows[:, :-1], rows[:, 1:]
+
+    state = create_pp_state(pl, CFG, tx, pp_mesh, T)
+    host_params = jax.device_get(state.params)
+    step = make_pp_train_step(pl, tx, pp_mesh, CFG, num_microbatches=2,
+                              schedule="1f1b", donate_state=False)
+    new_state, metrics = step(state, _put_batch(rows, pp_mesh))
+
+    def ref_loss(params):
+        logits = pl.apply_reference(params, jnp.asarray(tokens), train=True)
+        return cross_entropy_loss(logits, jnp.asarray(labels))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(host_params)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(loss_ref), rtol=1e-5
+    )
+    updates, _ = tx.update(grads_ref, tx.init(host_params), host_params)
+    ref_new = jax.tree.map(lambda p, u: p + u, host_params, updates)
+    got = jax.device_get(new_state.params)
+    for (pa, a), (pb, b) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(ref_new),
+               key=lambda kv: str(kv[0])),
+        sorted(jax.tree_util.tree_leaves_with_path(got),
+               key=lambda kv: str(kv[0])),
+    ):
+        assert str(pa) == str(pb)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, err_msg=str(pa)
+        )
+
+
+def test_pp_1f1b_with_weight_decay_and_more_microbatches(pp_mesh):
+    """L2 closed-form grads + M > S scheduling (steady-state 1F1B)."""
+    cfg = CFG.replace(weight_decay=5e-4)
+    pl = _pl()
+    tx = optax.sgd(0.1)
+    rows = _rows(16, seed=3)
+    tokens, labels = rows[:, :-1], rows[:, 1:]
+    state = create_pp_state(pl, cfg, tx, pp_mesh, T)
+    host_params = jax.device_get(state.params)
+    step = make_pp_train_step(pl, tx, pp_mesh, cfg, num_microbatches=8,
+                              schedule="1f1b", donate_state=False)
+    new_state, metrics = step(state, _put_batch(rows, pp_mesh))
+
+    from distributeddeeplearning_tpu.training.train_step import (
+        l2_kernel_penalty,
+    )
+
+    def ref_loss(params):
+        logits = pl.apply_reference(params, jnp.asarray(tokens), train=True)
+        return cross_entropy_loss(logits, jnp.asarray(labels)) + (
+            l2_kernel_penalty(params, cfg.weight_decay)
+        )
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(host_params)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(loss_ref), rtol=1e-5
+    )
+    updates, _ = tx.update(grads_ref, tx.init(host_params), host_params)
+    ref_new = jax.tree.map(lambda p, u: p + u, host_params, updates)
+    got = jax.device_get(new_state.params)
+    for (pa, a), (pb, b) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(ref_new),
+               key=lambda kv: str(kv[0])),
+        sorted(jax.tree_util.tree_leaves_with_path(got),
+               key=lambda kv: str(kv[0])),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, err_msg=str(pa)
+        )
