@@ -126,6 +126,12 @@ class TrainConfig:
     # over a 'model'/'expert' axis — the default), "fsdp" (ZeRO-3:
     # weights sharded over the data axis itself), "dp" (replicated).
     param_sharding: str = "tp"
+    # BatchNorm semantics guard: the pjit engine computes GLOBAL-batch
+    # (sync) BN statistics, while the dp engine keeps the reference's
+    # per-replica stats. A batch_stats-carrying model under ENGINE=pjit
+    # is refused unless this opt-in acknowledges the semantics change
+    # (checkpoints trained under the two engines are not comparable).
+    allow_sync_bn: bool = False
 
     # Bookkeeping
     seed: int = 42  # reference _SEED=42 (PyTorch :274-277, TF fake data :284)
@@ -150,11 +156,17 @@ class TrainConfig:
 
     @property
     def data_parallel_width(self) -> int:
-        """How many batch shards the mesh carries. Under the dp/pjit
-        engines every device is a batch slot (reference semantics; the
-        pjit engine's TP axes still consume replicated batches). Under
-        pp/sp only the ``replica``/``data`` axes shard the batch — the
-        pipe/seq axes partition the model/sequence instead."""
+        """How many batch shards the topology THIS CONFIG DESCRIBES
+        carries (for dataset sizing before any mesh exists). Under the
+        dp/pjit engines every device is a batch slot (reference
+        semantics; the pjit engine's TP axes still consume replicated
+        batches). Under pp/sp only the ``replica``/``data`` axes shard
+        the batch — pipe/seq partition the model/sequence instead.
+
+        Callers holding a *resolved* mesh (which may have been passed
+        explicitly and differ from the config) must use
+        ``parallel.mesh.dp_size(mesh)`` instead — ``loop.fit`` and the
+        front-ends do, for LR scaling and throughput accounting."""
         import jax
 
         n = jax.device_count()
@@ -246,6 +258,8 @@ class TrainConfig:
             kw["pp_schedule"] = e["PP_SCHEDULE"]
         if "PARAM_SHARDING" in e:
             kw["param_sharding"] = e["PARAM_SHARDING"]
+        if "ALLOW_SYNC_BN" in e:
+            kw["allow_sync_bn"] = _str_to_bool(e["ALLOW_SYNC_BN"])
         # Mesh topology (e.g. ENGINE=pjit MESH_AXES=data,model MESH_SHAPE=2,4)
         if "MESH_AXES" in e:
             kw["mesh_axes"] = tuple(

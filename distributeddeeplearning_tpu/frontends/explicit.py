@@ -68,9 +68,11 @@ def setup(
     from distributeddeeplearning_tpu.training.engines import build_engine
     from distributeddeeplearning_tpu.training.loop import resolve_engine
 
+    from distributeddeeplearning_tpu.parallel.mesh import dp_size
+
     _, mesh = resolve_engine(config, mesh)
     spe = steps_per_epoch or config.steps_per_epoch()
-    tx, schedule = create_optimizer(config, spe)
+    tx, schedule = create_optimizer(config, spe, world_size=dp_size(mesh))
     eng = build_engine(
         model, config, tx, mesh,
         input_shape=input_shape, input_dtype=input_dtype,

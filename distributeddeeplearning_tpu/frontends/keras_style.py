@@ -93,7 +93,13 @@ class Model:
             cfg = cfg.replace(
                 lr_decay_epochs=decay_epochs, lr_decay_factors=ratios
             )
-        tx, self.lr_schedule = create_optimizer(cfg, data.steps_per_epoch)
+        from distributeddeeplearning_tpu.parallel.mesh import dp_size
+        from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+        _, resolved_mesh = resolve_engine(cfg, self.mesh)
+        tx, self.lr_schedule = create_optimizer(
+            cfg, data.steps_per_epoch, world_size=dp_size(resolved_mesh)
+        )
         result = engine.fit(
             self.module,
             cfg,
@@ -136,14 +142,14 @@ class Model:
             from distributeddeeplearning_tpu.training.loop import resolve_engine
 
             tx, _ = create_optimizer(self.config, steps_per_epoch=1)
-            engine, mesh = resolve_engine(self.config, self.mesh)
-            if engine in ("pp", "sp"):
+            engine_name, mesh = resolve_engine(self.config, self.mesh)
+            if engine_name in ("pp", "sp"):
                 raise ValueError(
                     "load_weights before fit() is not supported under "
                     "ENGINE=pp/sp (the restore target needs the token "
                     "signature) — call fit(resume=True) instead"
                 )
-            if engine == "pjit":
+            if engine_name == "pjit":
                 # Restore target must carry the TP shardings, or a later
                 # fit() would train with silently-replicated params.
                 from distributeddeeplearning_tpu.training.pjit_step import (

@@ -53,7 +53,8 @@ def _sample(
         # inside the decode scan — don't sort twice)
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
     if top_k is not None:
-        kth = sorted_logits[:, top_k - 1][:, None]
+        # top_k >= vocab keeps everything (validated > 0 in generate())
+        kth = sorted_logits[:, min(top_k, logits.shape[-1]) - 1][:, None]
         logits = jnp.where(logits < kth, neg_inf, logits)
     if top_p is not None:
         probs = jax.nn.softmax(sorted_logits, axis=-1)
@@ -86,6 +87,8 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_token: Optional[int] = None,
+    pad_token: Optional[int] = None,
     rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, Tp]
@@ -94,11 +97,23 @@ def generate(
     ``model`` is a trained ``TransformerLM`` (its ``decode`` field is
     overridden here); ``params`` the trained parameters (e.g.
     ``state.params``). Greedy when ``temperature`` is 0 (default).
+
+    ``eos_token``: once a sequence emits it, its remaining positions are
+    filled with ``pad_token`` (default: the eos token itself) — shapes
+    stay static, finished rows just stop changing.
+
+    **Sharded states decode in place**: ``params`` may be TP- or
+    FSDP-sharded ``jax.Array``s (ENGINE=pjit state); the committed input
+    shardings drive GSPMD through the same jitted program — no host
+    gather, no replication (``tests/test_inference.py`` asserts
+    token-identity with the replicated path on the 8-device mesh).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, t_prompt = prompt.shape
@@ -109,9 +124,12 @@ def generate(
             f"prompt {t_prompt} + max_new_tokens {max_new_tokens} exceeds "
             f"model.max_seq_len {max_len}"
         )
+    if eos_token is not None and pad_token is None:
+        pad_token = eos_token
     try:
         cache_key = (
-            model, b, t_prompt, max_new_tokens, temperature, top_k, top_p
+            model, b, t_prompt, max_new_tokens, temperature, top_k, top_p,
+            eos_token, pad_token,
         )
         cached = _SAMPLER_CACHE.get(cache_key)
     except TypeError:  # unhashable model: no caching
@@ -143,9 +161,14 @@ def generate(
         )
         rng_0, rng_loop = jax.random.split(rng)
         first = _sample(logits[:, -1], rng_0, temperature, top_k, top_p)
+        done0 = (
+            first == eos_token
+            if eos_token is not None
+            else jnp.zeros((b,), bool)
+        )
 
         def body(carry, step_rng):
-            cache, tok = carry
+            cache, tok, done = carry
             logits, mutated = decode_model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -153,12 +176,16 @@ def generate(
                 mutable=["cache"],
             )
             nxt = _sample(logits[:, -1], step_rng, temperature, top_k, top_p)
-            return (mutated["cache"], nxt), nxt
+            if eos_token is not None:
+                # finished rows emit pad forever; shapes stay static
+                nxt = jnp.where(done, jnp.int32(pad_token), nxt)
+                done = done | (nxt == eos_token)
+            return (mutated["cache"], nxt, done), nxt
 
         if max_new_tokens == 1:
             return jnp.concatenate([prompt, first[:, None]], axis=1)
         step_rngs = jax.random.split(rng_loop, max_new_tokens - 1)
-        (_, _), rest = lax.scan(body, (mutated["cache"], first), step_rngs)
+        _, rest = lax.scan(body, (mutated["cache"], first, done0), step_rngs)
         return jnp.concatenate(
             [prompt, first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
         )

@@ -105,13 +105,20 @@ class MoEMlpBlock(nn.Module):
             kept_masks.append(masks[j] * keep[..., None])
             positions.append(loc.astype(jnp.int32))
 
-        # Combine weights: selected gates, renormalized over the kept
-        # choices so the expert mixture sums to 1 (matches the dense-MLP
-        # limit when all experts are identical).
+        # Combine weights. k >= 2: selected gates renormalized over the
+        # kept choices so the expert mixture sums to 1 (matches the
+        # dense-MLP limit when all experts are identical). k == 1: the
+        # RAW router probability (Switch convention) — renormalizing
+        # would make the weight identically 1 and cut the router's
+        # gradient through the output path, leaving only the aux loss.
         kept_gate = [
             chosen_gates[j] * jnp.sum(kept_masks[j], -1) for j in range(k)
         ]
-        denom = jnp.maximum(sum(kept_gate), 1e-9)
+        denom = (
+            jnp.ones_like(kept_gate[0])
+            if k == 1
+            else jnp.maximum(sum(kept_gate), 1e-9)
+        )
         # dispatch/combine: [b, s, e, c]
         dispatch = sum(
             kept_masks[j][..., None] * _one_hot_f32(positions[j], capacity)[:, :, None, :]

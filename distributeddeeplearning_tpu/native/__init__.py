@@ -257,9 +257,15 @@ def count_records(path: str, verify: bool = False) -> int:
 def fill_uniform(
     shape, seed: int, n_threads: Optional[int] = None
 ) -> np.ndarray:
-    """float32 uniform [0,1) array in splitmix64 counter mode:
+    """float32 uniform [0, 1] array in splitmix64 counter mode:
     ``out[i] = hash(seed + i)`` — bit-identical between the C++ and numpy
-    paths and for every thread count."""
+    paths and for every thread count.
+
+    The upper bound is CLOSED: uint32 draws >= 2^32 − 128 round up to
+    2^32 under float32, so exactly 1.0 appears with probability ~2^-25
+    (both paths round identically, preserving bit-identity). Harmless
+    for synthetic-image synthesis; account for it before reusing this as
+    a general-purpose [0, 1) generator."""
     n = int(np.prod(shape))
     out = np.empty(n, np.float32)
     lib = load_library()

@@ -158,8 +158,14 @@ def fit(
     epochs = epochs if epochs is not None else config.epochs
     steps_per_epoch = train_data.steps_per_epoch
 
+    # Batch-shard count from the RESOLVED mesh (an explicit `mesh` arg
+    # may differ from the topology config describes): drives the LR
+    # linear-scaling rule and the throughput accounting below.
+    from distributeddeeplearning_tpu.parallel.mesh import dp_size
+
+    n_batch_shards = dp_size(mesh)
     if tx is None:
-        tx, _ = create_optimizer(config, steps_per_epoch)
+        tx, _ = create_optimizer(config, steps_per_epoch, world_size=n_batch_shards)
     from distributeddeeplearning_tpu.training.engines import build_engine
 
     shape, dtype = _init_spec(train_data)
@@ -217,7 +223,7 @@ def fit(
     eval_step = eng.eval_step if eval_data is not None else None
 
     history: List[Dict[str, float]] = []
-    global_batch = config.global_batch_size
+    global_batch = config.batch_size_per_device * n_batch_shards
     run_timer = Timer().start()
     total_images = 0
     callback_list.on_train_begin({"state": state})

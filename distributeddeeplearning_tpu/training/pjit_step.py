@@ -113,15 +113,19 @@ def create_sharded_train_state(
     input_shape: Optional[Tuple[int, ...]] = None,
     rng: Optional[jax.Array] = None,
     input_dtype=None,
+    param_shardings: Optional[PyTree] = None,
 ) -> TrainState:
     """Seeded init, sharded at birth (no replicated intermediate).
     ``input_shape``/``input_dtype``: token models pass ((1, T), int32);
-    ``None`` dtype means float32 images."""
+    ``None`` dtype means float32 images. ``param_shardings``: pass the
+    tree from an earlier :func:`logical_shardings` call to skip the
+    abstract re-trace (``build_pjit_state`` does)."""
     rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
     shape = input_shape or (1, config.image_size, config.image_size, 3)
-    _, param_shardings = logical_shardings(
-        model, mesh, rules, shape, rng, input_dtype=input_dtype
-    )
+    if param_shardings is None:
+        _, param_shardings = logical_shardings(
+            model, mesh, rules, shape, rng, input_dtype=input_dtype
+        )
 
     from distributeddeeplearning_tpu.models.sharding import rules_for_mesh
 
@@ -306,15 +310,41 @@ def build_pjit_state(
     the explicit front-end, and Keras load_weights): sharded-at-birth
     init under the rules table ``config.param_sharding`` names ("tp" —
     the model-neutral default; "fsdp" — ZeRO-3 over the data axis;
-    "dp" — replicated)."""
+    "dp" — replicated).
+
+    Guards the BN semantics split (SURVEY §7 hard part (b)): this engine
+    normalizes with GLOBAL-batch statistics (sync-BN), the dp engine with
+    the reference's per-replica statistics. A batch_stats-carrying model
+    (ResNet/EfficientNet) is refused unless ``config.allow_sync_bn``
+    (env ``ALLOW_SYNC_BN=1``) opts into the different training semantics.
+    """
     from distributeddeeplearning_tpu.models.sharding import rules_table
+
+    rules = rules_table(config.param_sharding)
+    shape = input_shape or (1, config.image_size, config.image_size, 3)
+    # ONE abstract trace serves both the BN guard and the shardings.
+    abstract, param_shardings = logical_shardings(
+        model, mesh, rules, shape, input_dtype=input_dtype
+    )
+    if not config.allow_sync_bn and jax.tree.leaves(
+        abstract.get("batch_stats", {})
+    ):
+        raise ValueError(
+            f"model {type(model).__name__!r} carries BatchNorm "
+            "batch_stats: under ENGINE=pjit its statistics would be "
+            "GLOBAL-batch (sync-BN), not the per-replica statistics "
+            "the dp engine (and the reference) uses — training "
+            "semantics and checkpoints would silently differ. Use "
+            "ENGINE=dp, or set ALLOW_SYNC_BN=1 to accept sync-BN."
+        )
 
     return create_sharded_train_state(
         model,
         config,
         tx,
         mesh,
-        rules_table(config.param_sharding),
+        rules,
         input_shape=input_shape,
         input_dtype=input_dtype,
+        param_shardings=param_shardings,
     )

@@ -20,3 +20,24 @@ def test_run_bench_named_model_smoke(mesh8):
     )
     assert n_dev == 2
     assert np.isfinite(ips) and ips > 0
+
+
+def test_bench_scaling_emits_efficiency(mesh8, capsys, monkeypatch):
+    """BENCH_SCALING=1 must produce the scaling_efficiency field on the
+    multi-device mesh — the 8→64 measurement path cannot rot before
+    multi-chip hardware arrives (BASELINE >90% target)."""
+    import json
+
+    import bench
+
+    monkeypatch.setenv("BENCH_SCALING", "1")
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    monkeypatch.setenv("BENCH_DEPTH", "18")
+    monkeypatch.setenv("BENCH_IMAGE_SIZE", "16")
+    assert bench.main() == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    detail = out["detail"]
+    assert "scaling_efficiency" in detail, detail
+    assert 0.0 < detail["scaling_efficiency"] <= 1.5
+    assert detail["images_per_sec_1_device"] > 0

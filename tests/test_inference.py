@@ -148,3 +148,68 @@ def test_top_p_validation_and_dp_rules_allowed():
 
     engine, _ = resolve_engine(TrainConfig(engine="dp", param_sharding="dp"))
     assert engine == "dp"
+
+
+def test_top_k_validated():
+    model = _model()
+    params = _params(model)
+    prompt = np.zeros((1, 3), np.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, max_new_tokens=2,
+                 temperature=1.0, top_k=0)
+    # top_k > vocab is clamped (keeps everything), not an IndexError
+    out = np.asarray(generate(model, params, prompt, max_new_tokens=2,
+                              temperature=1.0, top_k=VOCAB + 100,
+                              rng=jax.random.PRNGKey(3)))
+    assert out.shape == (1, 5)
+
+
+def test_eos_freezes_finished_rows():
+    """After a row emits eos_token, its remaining positions are pad."""
+    model = _model()
+    params = _params(model)
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    ref = np.asarray(generate(model, params, prompt, max_new_tokens=10))
+    # pick the token the greedy path actually emits early, use it as eos
+    eos = int(ref[0, 4])  # second generated token
+    got = np.asarray(
+        generate(model, params, prompt, max_new_tokens=10,
+                 eos_token=eos, pad_token=0)
+    )
+    # identical up to and including the first eos, pad afterwards
+    np.testing.assert_array_equal(got[0, :5], ref[0, :5])
+    assert got[0, 4] == eos
+    np.testing.assert_array_equal(got[0, 5:], 0)
+
+
+def test_tp_sharded_state_decodes_token_identically(devices):
+    """VERDICT r2 #7: decode straight from a TP-sharded (ENGINE=pjit)
+    state on the 8-device mesh — no host gather, no replication — and
+    get exactly the replicated path's tokens."""
+    import optax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+    from distributeddeeplearning_tpu.training.pjit_step import build_pjit_state
+
+    model = _model()
+    mesh = create_mesh(axes=("data", "model"), shape=(2, 4))
+    cfg = TrainConfig(engine="pjit", num_classes=VOCAB,
+                      compute_dtype="float32", seed=7)
+    state = build_pjit_state(
+        model, cfg, optax.sgd(0.1), mesh,
+        input_shape=(1, MAX_LEN), input_dtype=jnp.int32,
+    )
+    qkv = state.params["block0"]["attn"]["qkv"]["kernel"]
+    assert "model" in tuple(qkv.sharding.spec)  # genuinely sharded
+
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, VOCAB, size=(2, 5)).astype(np.int32)
+    sharded_out = np.asarray(
+        generate(model, state.params, prompt, max_new_tokens=8)
+    )
+    host_params = jax.device_get(state.params)
+    ref_out = np.asarray(
+        generate(model, host_params, prompt, max_new_tokens=8)
+    )
+    np.testing.assert_array_equal(sharded_out, ref_out)

@@ -232,3 +232,30 @@ def test_rules_for_mesh_projection(devices):
     projected = dict(rules_for_mesh(mesh_ep))
     assert projected["expert"] == "expert"
     assert projected["heads"] is None
+
+
+def test_top1_router_gets_output_gradient():
+    """Switch-style top-1 routing: the combine weight is the RAW gate
+    probability, so the router kernel receives gradient through the
+    output path even with the aux loss disabled (ADVICE r2: renormalized
+    top-1 weights were identically 1 — gradient only via aux loss)."""
+    import flax.linen as nn
+
+    from distributeddeeplearning_tpu.models.moe import MoEMlpBlock
+
+    layer = MoEMlpBlock(num_experts=4, mlp_dim=8, num_selected=1,
+                   aux_loss_weight=0.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 4), jnp.float32)
+    variables = layer.init(jax.random.PRNGKey(1), x, train=False)
+
+    def out_sum(params):
+        y, _ = layer.apply(
+            {"params": params}, x, train=True, mutable=["losses"]
+        )
+        return jnp.sum(y)
+
+    grads = jax.grad(out_sum)(variables["params"])
+    flat = jax.tree_util.tree_leaves_with_path(grads)
+    router = [g for p, g in flat if "router" in str(p).lower() or "gate" in str(p).lower()]
+    assert router, [str(p) for p, _ in flat]
+    assert any(float(jnp.abs(g).max()) > 0 for g in router)
