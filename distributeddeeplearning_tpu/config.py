@@ -104,6 +104,10 @@ class TrainConfig:
     data_format: str = "auto"
     validation: bool = False
     num_workers: int = 4  # Keras NUM_WORKERS (:44-46)
+    # "thread" | "process" — the reference Keras MULTIPROCESSING knob
+    # (:44-46): process workers sidestep the GIL for Python-side
+    # decode/augment on many-core hosts.
+    worker_mode: str = "thread"
     prefetch_batches: int = 2
 
     # Distribution
@@ -228,6 +232,12 @@ class TrainConfig:
             kw["base_lr"] = float(e["LR"])
         if "NUM_WORKERS" in e:
             kw["num_workers"] = int(e["NUM_WORKERS"])
+        if "WORKER_MODE" in e:
+            kw["worker_mode"] = e["WORKER_MODE"]
+        elif "MULTIPROCESSING" in e:  # reference Keras spelling (:44-46)
+            kw["worker_mode"] = (
+                "process" if _str_to_bool(e["MULTIPROCESSING"]) else "thread"
+            )
         if "MODEL" in e:
             kw["model"] = e["MODEL"]
         if "ATTN_IMPL" in e:

@@ -53,6 +53,7 @@ def make_dataset(config, train: bool = True):
         train=train,
         seed=config.seed,
         num_workers=config.num_workers,
+        worker_mode=config.worker_mode,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         image_dtype=dtype,
@@ -70,6 +71,7 @@ def make_dataset(config, train: bool = True):
     from distributeddeeplearning_tpu.data.imagenet import TFRecordImageNetDataset
 
     common.pop("num_workers")  # tf.data autotunes its own parallelism
+    common.pop("worker_mode")  # (its C++ threads have no GIL to dodge)
     return TFRecordImageNetDataset(pattern, **common)
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import glob as globlib
+import multiprocessing
 import os
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -159,6 +160,23 @@ def _epoch_plan(
     return global_batch_size // process_count, steps
 
 
+# Worker-process decode target: the bound decode method is shipped ONCE
+# per worker via the pool initializer (pickling it per task would pickle
+# the whole dataset each time) — the Keras-reference MULTIPROCESSING
+# workers pattern (imagenet_keras_horovod.py:44-46, :332-342).
+_WORKER_DECODE = None
+
+
+def _set_worker_decode(decode):
+    global _WORKER_DECODE
+    _WORKER_DECODE = decode
+
+
+def _call_worker_decode(args):
+    ridx, epoch_index = args
+    return _WORKER_DECODE(ridx, epoch_index)
+
+
 def _threaded_epoch_batches(
     *,
     n_records: int,
@@ -171,6 +189,7 @@ def _threaded_epoch_batches(
     steps_per_epoch: int,
     num_workers: int,
     decode,
+    worker_mode: str = "thread",
 ):
     """Shared epoch driver for the PIL-decoding datasets (ImageFolder and
     native TFRecord): the same permutation on every process (seeded by
@@ -180,7 +199,19 @@ def _threaded_epoch_batches(
 
     ``decode(record_index, epoch_index) -> (image, label)`` supplies the
     storage-specific read+augment.
+
+    ``worker_mode``: ``"thread"`` (default — PIL releases the GIL during
+    libjpeg decompression, so threads scale across cores for the decode
+    itself) or ``"process"`` (the reference Keras path's
+    ``MULTIPROCESSING`` workers — sidesteps the GIL entirely for the
+    Python-side transform/augment code at the cost of spawn startup per
+    epoch; identical batches either way, asserted in
+    ``tests/test_imagenet_data.py``).
     """
+    if worker_mode not in ("thread", "process"):
+        raise ValueError(
+            f"worker_mode must be 'thread' or 'process', got {worker_mode!r}"
+        )
     order = np.arange(n_records)
     if train:
         np.random.RandomState((seed + epoch_index) % (2**31 - 1)).shuffle(order)
@@ -192,14 +223,34 @@ def _threaded_epoch_batches(
         )
     b = local_batch_size
 
-    def call(ridx):
-        return decode(int(ridx), epoch_index)
+    if worker_mode == "process":
+        pool_cm = concurrent.futures.ProcessPoolExecutor(
+            max(num_workers, 1),
+            mp_context=multiprocessing.get_context("spawn"),
+            initializer=_set_worker_decode,
+            initargs=(decode,),
+        )
 
-    with concurrent.futures.ThreadPoolExecutor(max(num_workers, 1)) as pool:
+        def submit(pool, idxs):
+            # chunk tasks per worker: one IPC round-trip per chunk, not
+            # per image (256 messages/step otherwise)
+            return pool.map(
+                _call_worker_decode,
+                [(int(i), epoch_index) for i in idxs],
+                chunksize=max(1, len(idxs) // (max(num_workers, 1) * 4)),
+            )
+
+    else:
+        pool_cm = concurrent.futures.ThreadPoolExecutor(max(num_workers, 1))
+
+        def submit(pool, idxs):
+            return pool.map(lambda i: decode(int(i), epoch_index), idxs)
+
+    with pool_cm as pool:
         for step in range(steps_per_epoch):
             if train:
                 idxs = [local[(step * b + j) % len(local)] for j in range(b)]
-                results = list(pool.map(call, idxs))
+                results = list(submit(pool, idxs))
                 yield (
                     np.stack([r[0] for r in results]),
                     np.asarray([r[1] for r in results], np.int32),
@@ -212,7 +263,7 @@ def _threaded_epoch_batches(
                 idxs = [
                     local[s] if s < len(local) else 0 for s in slots
                 ]
-                results = list(pool.map(call, idxs))
+                results = list(submit(pool, idxs))
                 yield (
                     np.stack([r[0] for r in results]),
                     np.asarray([r[1] for r in results], np.int32),
@@ -235,9 +286,11 @@ class ImageFolderDataset:
         process_index: int = 0,
         process_count: int = 1,
         image_dtype=np.float32,
+        worker_mode: str = "thread",
     ):
         _check_batch_divisible(global_batch_size, process_count)
         self.image_dtype = np.dtype(image_dtype)
+        self.worker_mode = worker_mode
         self.samples, self.classes = _list_samples(root)
         self.num_classes = len(self.classes)
         self.global_batch_size = global_batch_size
@@ -277,6 +330,7 @@ class ImageFolderDataset:
             steps_per_epoch=self.steps_per_epoch,
             num_workers=self.num_workers,
             decode=self._decode_sample,
+            worker_mode=self.worker_mode,
         )
 
     def __iter__(self):
@@ -482,6 +536,7 @@ class NativeTFRecordImageNetDataset:
         process_count: int = 1,
         image_dtype=np.float32,
         verify: bool = False,
+        worker_mode: str = "thread",
     ):
         from distributeddeeplearning_tpu.native import index_tfrecord
 
@@ -496,6 +551,7 @@ class NativeTFRecordImageNetDataset:
         self.train = train
         self.seed = seed
         self.num_workers = max(num_workers, 1)
+        self.worker_mode = worker_mode
         self.process_index = process_index
         self.process_count = process_count
 
@@ -550,6 +606,7 @@ class NativeTFRecordImageNetDataset:
             steps_per_epoch=self.steps_per_epoch,
             num_workers=self.num_workers,
             decode=self._decode_record,
+            worker_mode=self.worker_mode,
         )
 
     def __iter__(self):

@@ -35,6 +35,7 @@ import argparse
 import shlex
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Sequence
 
 from distributeddeeplearning_tpu.launch import ssh_command
@@ -184,17 +185,82 @@ def setup_commands(
     return cmds
 
 
+def _fmt(cmd: Sequence[str]) -> str:
+    return " ".join(shlex.quote(c) for c in cmd)
+
+
+def _is_ssh(cmd: Sequence[str]) -> bool:
+    """ssh/scp steps are the retryable ones: TPU-VM ssh fails transiently
+    for the first minute after pod creation (key propagation, guest
+    startup) — exactly the failure the reference's nodeprep loop also
+    tolerated by rerunning."""
+    return any(c in ("ssh", "scp") for c in cmd)
+
+
 def run_commands(
-    cmds: Sequence[Sequence[str]], dry_run: bool, sink=None
+    cmds: Sequence[Sequence[str]],
+    dry_run: bool,
+    sink=None,
+    *,
+    ssh_retries: int = 3,
+    retry_delay_s: float = 5.0,
 ) -> int:
+    """Run each command, streaming output; abort on the FIRST failure
+    with an ERROR line naming the failing step (a partial-worker failure
+    on ``--worker=all`` surfaces here as gcloud's nonzero rc — later
+    steps must not run against a half-configured pod). ssh/scp steps get
+    ``ssh_retries`` attempts with exponential backoff."""
     sink = sink or sys.stdout
     for cmd in cmds:
-        sink.write(" ".join(shlex.quote(c) for c in cmd) + "\n")
-        if not dry_run:
+        sink.write(_fmt(cmd) + "\n")
+        if dry_run:
+            continue
+        attempts = max(ssh_retries, 1) if _is_ssh(cmd) else 1
+        rc = 0
+        for attempt in range(attempts):
             rc = subprocess.call(list(cmd))
-            if rc != 0:
-                return rc
+            if rc == 0:
+                break
+            if attempt + 1 < attempts:
+                delay = retry_delay_s * (2**attempt)
+                sink.write(
+                    f"ssh attempt {attempt + 1}/{attempts} failed "
+                    f"(rc={rc}); retrying in {delay:g}s\n"
+                )
+                time.sleep(delay)
+        if rc != 0:
+            sink.write(f"ERROR: step failed (rc={rc}): {_fmt(cmd)}\n")
+            return rc
     return 0
+
+
+def run_pod_create(cmd: Sequence[str], dry_run: bool, sink=None) -> int:
+    """pod-create with idempotency: a pod that ALREADY EXISTS is not an
+    error (the reference's fixed-size cluster-create behaves the same
+    way on re-run) — any other failure (quota, bad zone) surfaces with
+    rc and an ERROR line."""
+    sink = sink or sys.stdout
+    sink.write(_fmt(cmd) + "\n")
+    if dry_run:
+        return 0
+    # gcloud reports BOTH its multi-minute creation progress and the
+    # ALREADY_EXISTS error on stderr — tee it line-by-line so the
+    # operator sees progress live while the text is captured for the
+    # idempotency check.
+    proc = subprocess.Popen(list(cmd), stderr=subprocess.PIPE, text=True)
+    captured = []
+    for line in proc.stderr:
+        sys.stderr.write(line)
+        sys.stderr.flush()
+        captured.append(line)
+    rc = proc.wait()
+    if rc != 0:
+        blob = "".join(captured).lower()
+        if "already exists" in blob or "alreadyexists" in blob:
+            sink.write("pod already exists — continuing (idempotent)\n")
+            return 0
+        sink.write(f"ERROR: step failed (rc={rc}): {_fmt(cmd)}\n")
+    return rc
 
 
 def _env_default(key: str, env_path: Optional[str]) -> Optional[str]:
@@ -214,6 +280,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--tpu", default=None)
     ap.add_argument("--zone", default=None)
     ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument(
+        "--ssh-retries", type=int, default=3,
+        help="attempts for ssh/scp steps (TPU-VM ssh is transiently "
+        "unavailable right after pod creation)",
+    )
+    ap.add_argument(
+        "--retry-delay", type=float, default=5.0,
+        help="base backoff seconds between ssh retries (doubles each try)",
+    )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     st = sub.add_parser("storage", help="create bucket + stage dataset")
@@ -240,13 +315,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = ap.parse_args(argv)
     project = args.project or _env_default("PROJECT", args.env_file)
 
+    import functools
+
+    run = functools.partial(
+        run_commands,
+        ssh_retries=args.ssh_retries,
+        retry_delay_s=args.retry_delay,
+    )
+
     if args.cmd == "storage":
         cmds = storage_commands(
             args.bucket, args.data, location=args.location, project=project
         )
         if not args.dry_run:
             set_key(dotenv_for(args.env_file), "BUCKET", args.bucket)
-        return run_commands(cmds, args.dry_run)
+        return run(cmds, args.dry_run)
 
     tpu = args.tpu or _env_default("TPU_NAME", args.env_file)
     zone = args.zone or _env_default("ZONE", args.env_file)
@@ -257,29 +340,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             env = dotenv_for(args.env_file)
             set_key(env, "TPU_NAME", tpu)
             set_key(env, "ZONE", zone)
-        return run_commands(
-            [
-                pod_create_command(
-                    tpu,
-                    zone,
-                    accelerator_type=args.accelerator_type,
-                    version=args.version,
-                    project=project,
-                    spot=args.spot,
-                )
-            ],
+        return run_pod_create(
+            pod_create_command(
+                tpu,
+                zone,
+                accelerator_type=args.accelerator_type,
+                version=args.version,
+                project=project,
+                spot=args.spot,
+            ),
             args.dry_run,
         )
     if args.cmd == "pod-status":
-        return run_commands(
+        return run(
             [pod_describe_command(tpu, zone, project=project)], args.dry_run
         )
     if args.cmd == "pod-delete":
-        return run_commands(
+        return run(
             [pod_delete_command(tpu, zone, project=project)], args.dry_run
         )
     if args.cmd == "setup":
-        return run_commands(
+        return run(
             setup_commands(
                 tpu, zone, bucket=args.bucket, image=args.image,
                 repo_dir=args.repo_dir, project=project,

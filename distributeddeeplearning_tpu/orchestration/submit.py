@@ -157,6 +157,21 @@ def control_command(
     return ssh_command(tpu, zone, remote, project=project)
 
 
+def _call_surfaced(cmd: Sequence[str]) -> int:
+    """subprocess.call with the failure made loud: a nonzero rc (pod
+    unreachable, job crashed in foreground mode, worker ssh refused)
+    prints an ERROR line naming the command instead of silently becoming
+    the exit code."""
+    rc = subprocess.call(list(cmd))
+    if rc != 0:
+        sys.stderr.write(
+            f"ERROR: command failed (rc={rc}): "
+            + " ".join(shlex.quote(c) for c in cmd)
+            + "\n"
+        )
+    return rc
+
+
 def _parse_env(pairs: Sequence[str]) -> Dict[str, str]:
     out = {}
     for p in pairs:
@@ -227,7 +242,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(" ".join(shlex.quote(c) for c in cmd))
         if args.dry_run:
             return 0
-        return subprocess.call(cmd)
+        return _call_surfaced(cmd)
 
     if args.cmd == "stream":
         cmd = stream_command(
@@ -241,7 +256,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     print(" ".join(shlex.quote(c) for c in cmd))
     if args.dry_run:
         return 0
-    return subprocess.call(cmd)
+    return _call_surfaced(cmd)
 
 
 if __name__ == "__main__":

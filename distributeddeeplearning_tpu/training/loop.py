@@ -223,6 +223,9 @@ def fit(
     eval_step = eng.eval_step if eval_data is not None else None
 
     history: List[Dict[str, float]] = []
+    # Throughput accounting counts what the dataset actually delivers
+    # (read off the staged batch's leading dim — shape metadata, no host
+    # sync), not a config-derived figure that can disagree with it.
     global_batch = config.batch_size_per_device * n_batch_shards
     run_timer = Timer().start()
     total_images = 0
@@ -236,6 +239,7 @@ def fit(
             train_data.epoch(epoch), mesh, size=config.prefetch_batches,
             sharding=eng.batch_sharding,
         ):
+            global_batch = int(jax.tree.leaves(batch)[0].shape[0])
             state, metrics = train_step(state, batch)
             step_in_epoch += 1
             if (

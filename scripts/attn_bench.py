@@ -1,0 +1,72 @@
+"""Attention fwd / fwd+bwd timing at long T (VERDICT r2 #8).
+
+Times the compiled forward and the compiled forward+backward (grad wrt
+q,k,v) for the flash (Pallas) and xla attention impls at T ∈ {8k, 32k},
+bf16 causal, d=128. Prints ms per call; the train step pays the
+fwd+bwd number every step.
+
+Usage: python scripts/attn_bench.py [T ...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.ops.attention import dot_product_attention
+
+
+def bench(impl: str, t: int, b: int = 1, h: int = 8, d: int = 128, steps: int = 5):
+    rng = np.random.RandomState(0)
+    shape = (b, t, h, d)  # BTHD layout
+    q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+
+    def fwd(q, k, v):
+        return dot_product_attention(q, k, v, causal=True, impl=impl)
+
+    def loss(q, k, v):
+        return jnp.sum(fwd(q, k, v).astype(jnp.float32))
+
+    results = {}
+    for name, fn in (("fwd", jax.jit(fwd)), ("fwd+bwd", jax.jit(jax.grad(loss, argnums=(0, 1, 2))))):
+        try:
+            out = fn(q, k, v)
+            leaf = jax.tree.leaves(out)[0]
+            float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))  # fence
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(q, k, v)
+            leaf = jax.tree.leaves(out)[0]
+            float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+            ms = (time.perf_counter() - t0) / steps * 1e3
+            results[name] = ms
+            print(f"{impl:7s} T={t:6d} {name:8s} {ms:9.1f} ms", flush=True)
+        except Exception as e:
+            print(f"{impl:7s} T={t:6d} {name:8s} FAILED: {type(e).__name__}: {e}",
+                  flush=True)
+    if "fwd" in results and "fwd+bwd" in results:
+        print(
+            f"{impl:7s} T={t:6d} bwd-only {results['fwd+bwd'] - results['fwd']:9.1f} ms "
+            f"(bwd/fwd = {(results['fwd+bwd'] - results['fwd']) / results['fwd']:.1f}x)",
+            flush=True,
+        )
+
+
+def main():
+    ts = [int(a) for a in sys.argv[1:]] or [8192, 32768]
+    for t in ts:
+        for impl in ("pallas", "xla"):
+            if impl == "xla" and t > 8192:
+                print(f"xla     T={t:6d} skipped ([T,T] materialization OOMs)")
+                continue
+            bench(impl, t)
+
+
+if __name__ == "__main__":
+    main()

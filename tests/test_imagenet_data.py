@@ -381,3 +381,29 @@ def test_make_dataset_format_resolution(image_tree, tmp_path, monkeypatch):
     cfg2 = cfg.replace(data_format="auto", data_dir=image_tree)
     ds2 = make_dataset(cfg2, train=True)
     assert type(ds2).__name__ == "ImageFolderDataset"
+
+
+def test_process_workers_match_thread_workers(image_tree):
+    """worker_mode='process' (the reference Keras MULTIPROCESSING knob)
+    yields bit-identical batches to the thread pool — per-sample seeded
+    augmentation makes decode order-independent."""
+    from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
+
+    kw = dict(global_batch_size=4, image_size=16, train=True, num_workers=2)
+    thread_ds = ImageFolderDataset(image_tree, **kw)
+    proc_ds = ImageFolderDataset(image_tree, worker_mode="process", **kw)
+    for (xi, yi), (xp, yp), _ in zip(thread_ds.epoch(1), proc_ds.epoch(1),
+                                     range(2)):
+        np.testing.assert_array_equal(xi, xp)
+        np.testing.assert_array_equal(yi, yp)
+    with pytest.raises(ValueError, match="worker_mode"):
+        next(iter(ImageFolderDataset(image_tree, worker_mode="fork", **kw).epoch(0)))
+
+
+def test_worker_mode_env_contract():
+    from distributeddeeplearning_tpu.config import TrainConfig
+
+    assert TrainConfig.from_env({"WORKER_MODE": "process"}).worker_mode == "process"
+    # reference Keras spelling (imagenet_keras_horovod.py:44-46)
+    assert TrainConfig.from_env({"MULTIPROCESSING": "True"}).worker_mode == "process"
+    assert TrainConfig.from_env({"MULTIPROCESSING": "False"}).worker_mode == "thread"
