@@ -201,9 +201,15 @@ class TransformerLM(nn.Module):
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Tied output projection (standard LM practice; halves embedding
-        # params vs an untied head).
+        # params vs an untied head). Operands in the compute dtype so the
+        # MXU runs at full bf16 rate, accumulation and logits in f32
+        # (the standard LM mixed-precision recipe — the [B, T, V] logits
+        # tensor itself stays f32 for the CE).
         logits = jnp.einsum(
-            "btd,vd->btv", x.astype(jnp.float32), embed.astype(jnp.float32)
+            "btd,vd->btv",
+            x.astype(self.dtype),
+            embed.astype(self.dtype),
+            preferred_element_type=jnp.float32,
         )
         return logits
 
