@@ -1,0 +1,242 @@
+"""Fused bottleneck-segment kernels — PROFILE.md roadmap item 1 (partial).
+
+ResNet training on v5e is HBM-bound; the bytes XLA cannot remove are the
+separate BatchNorm *statistics* passes (a reduce cannot fuse into the
+producing convolution at the XLA level) and the materialized
+``relu(bn(·))`` activation between a BN and a following 1×1 convolution.
+A bottleneck block's two 1×1 convolutions are matmuls, so both sites fuse
+into single Pallas kernels:
+
+* :func:`matmul_stats` — ``y = a @ w`` with per-column ``(Σy, Σy²)``
+  accumulated in the same pass (the block-entry 1×1 conv + BN-stats
+  epilogue). The stats pass over ``y`` never runs.
+* :func:`bn_relu_matmul_stats` — ``y = relu((a − μ)·γ/σ + β) @ w`` with
+  the same stats epilogue (the BN2→ReLU→conv3 tail). The normalized
+  activation lives only in VMEM: never written to, never re-read from
+  HBM, and the stats pass over ``y`` never runs either.
+
+Both carry a custom VJP whose backward is pure JAX with recompute
+(bn/relu recomputed from the saved *pre*-norm input) — backward byte
+traffic matches XLA's existing backward, so the saving is forward-side;
+the measured win is recorded in PROFILE.md. Exact-parity with the
+unfused graph is asserted in ``tests/test_fused_block.py`` (f32 exact;
+the only bf16 difference is MXU rounding of the same math).
+
+TPU grids execute sequentially on a core, so the ``(Σ, Σ²)``
+accumulators live in VMEM scratch across the row-block grid and are
+written once by the last program — the same pattern as the flash
+kernels' online state (``ops/pallas/flash.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SUB = 8  # sublane tiling quantum for the stats accumulators
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _vma(*arrays):
+    out = set()
+    for a in arrays:
+        out |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    return frozenset(out)
+
+
+def _kernel(
+    a_ref, w_ref, aff_ref, y_ref, sum_ref, sumsq_ref, s_sum, s_sumsq,
+    *, m_len: int, prologue: str,
+):
+    """One row-block program: prologue → matmul → stats accumulation.
+
+    ``aff_ref`` ``[SUB, K]`` f32 carries the folded BN affine: row 0 =
+    ``γ/σ``, row 1 = ``β − μ·γ/σ`` (unused for prologue='none').
+    """
+    i = pl.program_id(0)
+    bm = a_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _init():
+        s_sum[:] = jnp.zeros_like(s_sum)
+        s_sumsq[:] = jnp.zeros_like(s_sumsq)
+
+    a = a_ref[...]
+    if prologue == "bn_relu":
+        z = jnp.maximum(
+            a.astype(jnp.float32) * aff_ref[0:1, :] + aff_ref[1:2, :], 0.0
+        ).astype(a.dtype)
+    else:
+        z = a
+    # Padded trailing rows must not reach the stats (their matmul rows
+    # are sliced off by the caller, but the reduction sums everything).
+    row = i * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    z = jnp.where(row < m_len, z, jnp.zeros_like(z))
+    y32 = jax.lax.dot_general(
+        z, w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    y = y32.astype(y_ref.dtype)
+    y_ref[...] = y
+    # Stats from the ROUNDED output (what the unfused BN would read from
+    # HBM), grouped mod-SUB so the accumulator tiles (8, 128).
+    yr = y.astype(jnp.float32).reshape(bm // _SUB, _SUB, -1)
+    s_sum[:] = s_sum[:] + jnp.sum(yr, axis=0)
+    s_sumsq[:] = s_sumsq[:] + jnp.sum(yr * yr, axis=0)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _finalize():
+        sum_ref[...] = s_sum[:]
+        sumsq_ref[...] = s_sumsq[:]
+
+
+def _run(a, w, affine, *, prologue: str, block_m: int = 512):
+    m, k = a.shape
+    n = w.shape[1]
+    bm = min(block_m, _ceil_to(m, _SUB))
+    m_p = _ceil_to(m, bm)
+    ap = jnp.pad(a, ((0, m_p - m), (0, 0)))
+    interpret = jax.default_backend() != "tpu"
+    vma = _vma(a, w, affine)
+    y, s, ss = pl.pallas_call(
+        functools.partial(_kernel, m_len=m, prologue=prologue),
+        grid=(m_p // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+            pl.BlockSpec((_SUB, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((_SUB, n), lambda i: (0, 0)),
+            pl.BlockSpec((_SUB, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_p, n), a.dtype, vma=vma),
+            jax.ShapeDtypeStruct((_SUB, n), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((_SUB, n), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_SUB, n), jnp.float32),
+            pltpu.VMEM((_SUB, n), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(ap, w, affine)
+    return y[:m], jnp.sum(s, axis=0), jnp.sum(ss, axis=0)
+
+
+def _affine_rows(k: int, mean, var, scale, bias, eps: float):
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps) * scale.astype(jnp.float32)
+    shift = bias.astype(jnp.float32) - mean.astype(jnp.float32) * inv
+    rows = jnp.stack([inv, shift], axis=0)  # [2, K]
+    return jnp.pad(rows, ((0, _SUB - 2), (0, 0)))
+
+
+# ---------------------------------------------------------------- ops --
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def matmul_stats(a, w):
+    """``[M, K] @ [K, N] → ([M, N], Σcol [N], Σcol² [N])`` in one pass."""
+    aff = jnp.zeros((_SUB, a.shape[1]), jnp.float32)
+    return _run(a, w, aff, prologue="none")
+
+
+def _matmul_stats_fwd(a, w):
+    out = matmul_stats(a, w)
+    y = out[0]
+    return out, (a, w, y)
+
+
+def _matmul_stats_bwd(res, cts):
+    a, w, y = res
+    dy, dsum, dsumsq = cts
+    dy_eff = (
+        dy.astype(jnp.float32)
+        + dsum[None, :]
+        + 2.0 * y.astype(jnp.float32) * dsumsq[None, :]
+    )
+    dyc = dy_eff.astype(a.dtype)
+    da = jax.lax.dot_general(
+        dyc, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(a.dtype)
+    dw = jax.lax.dot_general(
+        a, dyc, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(w.dtype)
+    return da, dw
+
+
+matmul_stats.defvjp(_matmul_stats_fwd, _matmul_stats_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def bn_relu_matmul_stats(a, mean, var, scale, bias, w, eps=1e-5):
+    """``y = relu((a − μ)·γ/σ + β) @ w`` plus ``(Σy, Σy²)`` — the
+    normalized activation exists only in VMEM."""
+    aff = _affine_rows(a.shape[1], mean, var, scale, bias, eps)
+    return _run(a, w, aff, prologue="bn_relu")
+
+
+def _bn_fwd(a, mean, var, scale, bias, w, eps):
+    out = bn_relu_matmul_stats(a, mean, var, scale, bias, w, eps)
+    return out, (a, mean, var, scale, bias, w, out[0])
+
+
+def _bn_bwd(eps, res, cts):
+    a, mean, var, scale, bias, w, y = res
+    dy, dsum, dsumsq = cts
+    cdt = a.dtype  # keep the big [M, ·] intermediates in the compute dtype
+    dy_eff = (
+        dy.astype(jnp.float32)
+        + dsum[None, :]
+        + 2.0 * y.astype(jnp.float32) * dsumsq[None, :]
+    ).astype(cdt)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    g = inv * scale.astype(jnp.float32)  # [K]
+    pre = a.astype(jnp.float32) * g[None, :] + (
+        bias.astype(jnp.float32) - mean.astype(jnp.float32) * g
+    )[None, :]
+    zmask = pre > 0.0
+    z = jnp.where(zmask, pre, 0.0).astype(cdt)
+    dw = jax.lax.dot_general(
+        z, dy_eff, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(w.dtype)
+    dz = jax.lax.dot_general(
+        dy_eff, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dzb = jnp.where(zmask, dz, 0.0).astype(cdt)  # through relu
+    da = (dzb.astype(jnp.float32) * g[None, :]).astype(a.dtype)
+    ahat = (
+        (a.astype(jnp.float32) - mean.astype(jnp.float32)[None, :])
+        * inv[None, :]
+    ).astype(cdt)
+    dscale = jnp.sum(
+        (dzb * ahat).astype(jnp.float32), axis=0
+    ).astype(scale.dtype)
+    dbias = jnp.sum(dzb.astype(jnp.float32), axis=0).astype(bias.dtype)
+    dmean = (-jnp.sum(dzb.astype(jnp.float32), axis=0) * g).astype(mean.dtype)
+    # dz/dσ² = (a−μ)·γ·(−½)σ⁻³ = −½·γ·x̂·inv²
+    dvar = (
+        -0.5
+        * jnp.sum((dzb * ahat).astype(jnp.float32), axis=0)
+        * scale.astype(jnp.float32)
+        * inv
+        * inv
+    ).astype(var.dtype)
+    return da, dmean, dvar, dscale, dbias, dw
+
+
+bn_relu_matmul_stats.defvjp(_bn_fwd, _bn_bwd)

@@ -5,7 +5,9 @@ vs space-to-depth stem. Prints one line per variant with
 ``cost_analysis()["bytes accessed"]`` and 20-step wall time.
 
 Usage: python scripts/profile_variants.py [variant ...]
-Variants: base bf16stats s2d both  (default: all)
+Variants: base bf16stats s2d both fused
+(default: all except ``fused`` — the recorded net-negative Pallas
+fused-block experiment, ~2× slower; run it explicitly)
 """
 
 from __future__ import annotations
@@ -34,6 +36,8 @@ VARIANTS = {
     "bf16stats": {"stats_dtype": jnp.bfloat16},
     "s2d": {"s2d_stem": True},
     "both": {"stats_dtype": jnp.bfloat16, "s2d_stem": True},
+    # Pallas fused bottleneck segments (ops/pallas/fused_block.py)
+    "fused": {"fused": True},
 }
 
 
@@ -78,6 +82,6 @@ def run(name: str, batch_size: int = 256, steps: int = 20):
 
 
 if __name__ == "__main__":
-    names = sys.argv[1:] or list(VARIANTS)
+    names = sys.argv[1:] or [v for v in VARIANTS if v != "fused"]
     for name in names:
         run(name)

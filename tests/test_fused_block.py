@@ -1,0 +1,129 @@
+"""Fused bottleneck-segment ops (ops/pallas/fused_block.py).
+
+The fused path is the PROFILE.md roadmap-item-1 experiment (measured a
+net LOSS on hardware — kept flag-gated off; see PROFILE.md). These tests
+pin its correctness: op-level values/grads against pure-JAX references,
+and block-level exact parity (params, outputs, grads, running stats)
+with the standard BottleneckBlock.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.ops.pallas.fused_block import (
+    bn_relu_matmul_stats,
+    matmul_stats,
+)
+
+
+def _ref_mm(a, w):
+    y = a @ w
+    return y, jnp.sum(y, 0), jnp.sum(y * y, 0)
+
+
+def _ref_bn(a, mean, var, scale, bias, w, eps=1e-5):
+    z = jnp.maximum(
+        (a - mean) * jax.lax.rsqrt(var + eps) * scale + bias, 0.0
+    )
+    y = z @ w
+    return y, jnp.sum(y, 0), jnp.sum(y * y, 0)
+
+
+def _inputs(m=70, k=16, n=24, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(m, k).astype(np.float32)),
+        jnp.asarray(rng.randn(k).astype(np.float32) * 0.1),
+        jnp.asarray(np.abs(rng.randn(k)).astype(np.float32) + 0.5),
+        jnp.asarray(rng.randn(k).astype(np.float32)),
+        jnp.asarray(rng.randn(k).astype(np.float32) * 0.1),
+        jnp.asarray(rng.randn(k, n).astype(np.float32)),
+    )
+
+
+def _scalar_loss(fn):
+    def f(*args):
+        y, s, ss = fn(*args)
+        return (
+            jnp.sum(y**2) + jnp.sum(jnp.sin(s)) + jnp.sum(jnp.cos(ss * 1e-2))
+        )
+
+    return f
+
+
+def test_matmul_stats_values_and_grads():
+    a, _, _, _, _, w = _inputs()
+    for g, r in zip(matmul_stats(a, w), _ref_mm(a, w)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+    g_got = jax.grad(_scalar_loss(matmul_stats), argnums=(0, 1))(a, w)
+    g_ref = jax.grad(_scalar_loss(_ref_mm), argnums=(0, 1))(a, w)
+    for gg, gr in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gr), atol=2e-3)
+
+
+def test_bn_relu_matmul_stats_values_and_grads():
+    args = _inputs()
+    for g, r in zip(bn_relu_matmul_stats(*args), _ref_bn(*args)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+    g_got = jax.grad(
+        _scalar_loss(bn_relu_matmul_stats), argnums=tuple(range(6))
+    )(*args)
+    g_ref = jax.grad(_scalar_loss(_ref_bn), argnums=tuple(range(6)))(*args)
+    for name, gg, gr in zip(
+        ("a", "mean", "var", "scale", "bias", "w"), g_got, g_ref
+    ):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=5e-3, err_msg=name
+        )
+
+
+def test_fused_block_matches_standard_block():
+    """Same variable tree (paths AND init values), same forward, same
+    grads, same running-stat updates, train and eval — the fused path is
+    a drop-in reimplementation, checkpoint-compatible both ways."""
+    from distributeddeeplearning_tpu.models.resnet import BottleneckBlock
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 16), jnp.float32) * 2
+    std = BottleneckBlock(filters=8, strides=2, dtype=jnp.float32)
+    fus = BottleneckBlock(filters=8, strides=2, dtype=jnp.float32, fused=True)
+    v_std = std.init(jax.random.PRNGKey(2), x, train=False)
+    v_fus = fus.init(jax.random.PRNGKey(2), x, train=False)
+    assert jax.tree.structure(v_std) == jax.tree.structure(v_fus)
+    for (p1, a), (p2, b) in zip(
+        jax.tree_util.tree_leaves_with_path(v_std),
+        jax.tree_util.tree_leaves_with_path(v_fus),
+    ):
+        assert str(p1) == str(p2) and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def loss(model):
+        def f(params):
+            out, mut = model.apply(
+                {"params": params, "batch_stats": v_std["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return jnp.sum(out * out), mut
+
+        return f
+
+    (l_s, mut_s), g_s = jax.value_and_grad(loss(std), has_aux=True)(
+        v_std["params"]
+    )
+    (l_f, mut_f), g_f = jax.value_and_grad(loss(fus), has_aux=True)(
+        v_std["params"]
+    )
+    np.testing.assert_allclose(float(l_s), float(l_f), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+    for a, b in zip(
+        jax.tree.leaves(mut_s["batch_stats"]),
+        jax.tree.leaves(mut_f["batch_stats"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(std.apply(v_std, x, train=False)),
+        np.asarray(fus.apply(v_fus, x, train=False)),
+        atol=1e-5,
+    )
