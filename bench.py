@@ -173,6 +173,70 @@ def run_lm_bench(
     return tokens_per_sec, n_dev
 
 
+def run_decode_bench(model_name: str, batch: int, prompt_len: int, new_tokens: int):
+    """Inference tier: generated tokens/sec through the KV-cache sampler
+    (``inference.generate``) — selected via ``BENCH_DECODE=1``."""
+    import os
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.inference import generate
+    from distributeddeeplearning_tpu.models import get_model
+
+    vocab = int(os.environ.get("BENCH_VOCAB", "32000"))
+    max_len = prompt_len + new_tokens
+    model = get_model(model_name, num_classes=vocab, max_seq_len=max_len)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.PRNGKey(0), jnp.zeros((batch, max_len), jnp.int32),
+        train=False,
+    )
+    params = nn.unbox(variables["params"])
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, vocab, size=(batch, prompt_len)).astype(np.int32)
+    kw = dict(max_new_tokens=new_tokens, temperature=0.8, top_k=40,
+              rng=jax.random.PRNGKey(1))
+    out = generate(model, params, prompt, **kw)  # compile + warmup
+    int(np.asarray(out)[0, -1])
+    t0 = time.perf_counter()
+    reps = 3
+    for i in range(reps):
+        out = generate(model, params, prompt,
+                       **{**kw, "rng": jax.random.PRNGKey(2 + i)})
+    int(np.asarray(out)[0, -1])  # fence
+    dt = time.perf_counter() - t0
+    return reps * batch * new_tokens / dt
+
+
+def decode_main():
+    import os
+
+    model_name = os.environ.get("BENCH_MODEL", "lm_small")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+    try:
+        tps = run_decode_bench(model_name, batch, prompt_len, new_tokens)
+        print(json.dumps({
+            "metric": f"{model_name}_decode_tokens_per_sec",
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,  # the reference has no inference path
+            "detail": {
+                "batch": batch, "prompt_len": prompt_len,
+                "new_tokens": new_tokens,
+                "platform": jax.devices()[0].platform,
+            },
+        }))
+        return 0
+    except Exception as e:
+        print(json.dumps({
+            "metric": f"{model_name}_decode_tokens_per_sec", "value": 0.0,
+            "unit": "tokens/sec", "vs_baseline": 0.0, "error": repr(e),
+        }))
+        return 1
+
+
 def lm_main():
     import os
 
@@ -231,6 +295,8 @@ def lm_main():
 def main():
     import os
 
+    if os.environ.get("BENCH_DECODE", "") == "1":
+        return decode_main()
     if os.environ.get("BENCH_MODEL", "").startswith("lm_"):
         return lm_main()
 

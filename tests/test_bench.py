@@ -41,3 +41,21 @@ def test_bench_scaling_emits_efficiency(mesh8, capsys, monkeypatch):
     assert "scaling_efficiency" in detail, detail
     assert 0.0 < detail["scaling_efficiency"] <= 1.5
     assert detail["images_per_sec_1_device"] > 0
+
+
+def test_bench_decode_mode(mesh8, capsys, monkeypatch):
+    """BENCH_DECODE=1 emits the decode-throughput JSON line."""
+    import json
+
+    import bench
+
+    monkeypatch.setenv("BENCH_DECODE", "1")
+    monkeypatch.setenv("BENCH_MODEL", "lm_tiny")
+    monkeypatch.setenv("BENCH_VOCAB", "64")
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    monkeypatch.setenv("BENCH_PROMPT_LEN", "4")
+    monkeypatch.setenv("BENCH_NEW_TOKENS", "4")
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "lm_tiny_decode_tokens_per_sec"
+    assert out["value"] > 0
