@@ -16,6 +16,16 @@ On a TPU pod slice, launch with ``python -m distributeddeeplearning_tpu.
 launch`` on every host (or let your job scheduler do it) — same script.
 """
 
+# Allow `python examples/<name>.py` from a repo checkout without an
+# install: put the repo root (this file's parent's parent) on sys.path.
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data import make_input_fn
 from distributeddeeplearning_tpu.frontends import Estimator, RunConfig

@@ -12,6 +12,16 @@ Run locally::
         python examples/imagenet_explicit_tpu.py
 """
 
+# Allow `python examples/<name>.py` from a repo checkout without an
+# install: put the repo root (this file's parent's parent) on sys.path.
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+
 import jax
 
 from distributeddeeplearning_tpu.config import TrainConfig

@@ -13,6 +13,16 @@ Run locally::
         python examples/imagenet_keras_tpu.py
 """
 
+# Allow `python examples/<name>.py` from a repo checkout without an
+# install: put the repo root (this file's parent's parent) on sys.path.
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data import make_dataset
 from distributeddeeplearning_tpu.frontends import Model

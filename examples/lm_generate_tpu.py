@@ -1,0 +1,124 @@
+"""Autoregressive generation from a trained (or fresh) LM checkpoint.
+
+The inference counterpart of ``lm_synthetic_tpu.py``: restores a
+checkpoint if ``MODEL_DIR`` points at one (otherwise seeds fresh
+params), then samples continuations through the KV-cache sampler
+(``inference.generate`` — one jitted prefill+scan program; greedy /
+temperature / top-k / top-p; EOS early-stop).
+
+Env contract (the usual spellings plus the sampler's)::
+
+    MODEL=lm_small VOCAB=32000 SEQ_LEN=256 BATCHSIZE=4 \
+    MAX_NEW_TOKENS=64 TEMPERATURE=0.8 TOP_K=40 TOP_P=0.95 [EOS_TOKEN=2] \
+    [MODEL_DIR=checkpoints/] python examples/lm_generate_tpu.py
+"""
+
+from __future__ import annotations
+
+# Allow `python examples/<name>.py` from a repo checkout without an
+# install: put the repo root (this file's parent's parent) on sys.path.
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import os
+
+import numpy as np
+
+
+def main():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.inference import generate
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.utils.logging import get_logger
+
+    log = get_logger()
+    vocab = int(os.environ.get("VOCAB", "32000"))
+    seq_len = int(os.environ.get("SEQ_LEN", "256"))
+    new_tokens = int(os.environ.get("MAX_NEW_TOKENS", "64"))
+    prompt_len = int(os.environ.get("PROMPT_LEN", "16"))
+    temperature = float(os.environ.get("TEMPERATURE", "0.8"))
+    top_k = int(os.environ["TOP_K"]) if "TOP_K" in os.environ else None
+    top_p = float(os.environ["TOP_P"]) if "TOP_P" in os.environ else None
+    eos = int(os.environ["EOS_TOKEN"]) if "EOS_TOKEN" in os.environ else None
+    defaults = {} if "MODEL" in os.environ else {"model": "lm_small"}
+    cfg = TrainConfig.from_env(num_classes=vocab, **defaults)
+
+    if cfg.model_dir and prompt_len + new_tokens > seq_len:
+        # the checkpoint's pos_embed is sized by the TRAINING seq_len —
+        # a longer table cannot be restored into
+        raise SystemExit(
+            f"PROMPT_LEN+MAX_NEW_TOKENS ({prompt_len + new_tokens}) exceeds "
+            f"the checkpoint's SEQ_LEN ({seq_len}) — raise SEQ_LEN to the "
+            "value the model was trained with"
+        )
+    model = get_model(
+        cfg.model, **cfg.model_kwargs(),
+        max_seq_len=seq_len if cfg.model_dir else max(
+            seq_len, prompt_len + new_tokens
+        ),
+    )
+    if cfg.model_dir:
+        from distributeddeeplearning_tpu.training import (
+            create_optimizer,
+            create_train_state,
+        )
+        from distributeddeeplearning_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        tx, _ = create_optimizer(cfg, steps_per_epoch=1)
+        state = create_train_state(
+            model, cfg, tx, input_shape=(1, seq_len), input_dtype=jnp.int32
+        )
+        mgr = CheckpointManager(cfg.model_dir)
+        latest = mgr.latest_epoch()
+        if latest is None:
+            mgr.close()
+            raise SystemExit(
+                f"MODEL_DIR={cfg.model_dir}: no checkpoint found — train "
+                "first (examples/lm_synthetic_tpu.py) or unset MODEL_DIR "
+                "to sample from fresh params"
+            )
+        state, _ = mgr.maybe_restore(state)
+        mgr.close()
+        params = state.params
+        log.info(
+            "restored %s from %s (epoch %d)", cfg.model, cfg.model_dir, latest
+        )
+    else:
+        variables = jax.jit(model.init, static_argnames=("train",))(
+            jax.random.PRNGKey(cfg.seed),
+            jnp.zeros((1, seq_len), jnp.int32),
+            train=False,
+        )
+        params = nn.unbox(variables["params"])
+        log.info("no MODEL_DIR: sampling from fresh seeded params")
+
+    rng = np.random.RandomState(cfg.seed)
+    batch = cfg.batch_size_per_device
+    prompt = rng.randint(0, vocab, size=(batch, prompt_len)).astype(np.int32)
+    out = generate(
+        model, params, prompt,
+        max_new_tokens=new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, eos_token=eos,
+        rng=jax.random.PRNGKey(cfg.seed + 1),
+    )
+    out = np.asarray(out)
+    for i, row in enumerate(out):
+        log.info("sample %d: %s ...", i, " ".join(map(str, row[: prompt_len + 12])))
+    log.info(
+        "generated %d x %d tokens (%s)", batch, new_tokens,
+        f"eos={eos}" if eos is not None else "no eos",
+    )
+
+
+if __name__ == "__main__":
+    main()

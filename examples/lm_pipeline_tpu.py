@@ -18,6 +18,15 @@ Env contract (the usual reference-style knobs plus PP's own)::
 
 from __future__ import annotations
 
+# Allow `python examples/<name>.py` from a repo checkout without an
+# install: put the repo root (this file's parent's parent) on sys.path.
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
 import os
 
 import jax

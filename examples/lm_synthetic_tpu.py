@@ -16,6 +16,16 @@ or across 2 processes::
         --env VOCAB=256 examples/lm_synthetic_tpu.py
 """
 
+# Allow `python examples/<name>.py` from a repo checkout without an
+# install: put the repo root (this file's parent's parent) on sys.path.
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+
 import os
 
 import jax.numpy as jnp
