@@ -8,9 +8,12 @@ temperature / top-k / top-p; EOS early-stop).
 
 Env contract (the usual spellings plus the sampler's)::
 
-    MODEL=lm_small VOCAB=32000 SEQ_LEN=256 BATCHSIZE=4 \
+    MODEL=lm_tiny VOCAB=32000 SEQ_LEN=128 BATCHSIZE=4 PROMPT_LEN=16 \
     MAX_NEW_TOKENS=64 TEMPERATURE=0.8 TOP_K=40 TOP_P=0.95 [EOS_TOKEN=2] \
     [MODEL_DIR=checkpoints/] python examples/lm_generate_tpu.py
+
+Defaults (model, SEQ_LEN, seed) mirror ``lm_synthetic_tpu.py`` so its
+default-trained checkpoint restores here with just ``MODEL_DIR=``.
 """
 
 from __future__ import annotations
@@ -30,7 +33,6 @@ import numpy as np
 
 
 def main():
-    import flax.linen as nn
     import jax
     import jax.numpy as jnp
 
@@ -40,15 +42,17 @@ def main():
     from distributeddeeplearning_tpu.utils.logging import get_logger
 
     log = get_logger()
+    # Defaults mirror examples/lm_synthetic_tpu.py so a default-trained
+    # checkpoint restores here without extra env.
     vocab = int(os.environ.get("VOCAB", "32000"))
-    seq_len = int(os.environ.get("SEQ_LEN", "256"))
+    seq_len = int(os.environ.get("SEQ_LEN", "128"))
     new_tokens = int(os.environ.get("MAX_NEW_TOKENS", "64"))
     prompt_len = int(os.environ.get("PROMPT_LEN", "16"))
     temperature = float(os.environ.get("TEMPERATURE", "0.8"))
     top_k = int(os.environ["TOP_K"]) if "TOP_K" in os.environ else None
     top_p = float(os.environ["TOP_P"]) if "TOP_P" in os.environ else None
     eos = int(os.environ["EOS_TOKEN"]) if "EOS_TOKEN" in os.environ else None
-    defaults = {} if "MODEL" in os.environ else {"model": "lm_small"}
+    defaults = {} if "MODEL" in os.environ else {"model": "lm_tiny"}
     cfg = TrainConfig.from_env(num_classes=vocab, **defaults)
 
     if cfg.model_dir and prompt_len + new_tokens > seq_len:
@@ -65,19 +69,22 @@ def main():
             seq_len, prompt_len + new_tokens
         ),
     )
+    from distributeddeeplearning_tpu.training import (
+        create_optimizer,
+        create_train_state,
+    )
+
+    # ONE construction point for the seeded params (jit init, unboxed
+    # logical-partitioning metadata) — also the checkpoint-restore target.
+    tx, _ = create_optimizer(cfg, steps_per_epoch=1)
+    state = create_train_state(
+        model, cfg, tx, input_shape=(1, seq_len), input_dtype=jnp.int32
+    )
     if cfg.model_dir:
-        from distributeddeeplearning_tpu.training import (
-            create_optimizer,
-            create_train_state,
-        )
         from distributeddeeplearning_tpu.training.checkpoint import (
             CheckpointManager,
         )
 
-        tx, _ = create_optimizer(cfg, steps_per_epoch=1)
-        state = create_train_state(
-            model, cfg, tx, input_shape=(1, seq_len), input_dtype=jnp.int32
-        )
         mgr = CheckpointManager(cfg.model_dir)
         latest = mgr.latest_epoch()
         if latest is None:
@@ -89,18 +96,12 @@ def main():
             )
         state, _ = mgr.maybe_restore(state)
         mgr.close()
-        params = state.params
         log.info(
             "restored %s from %s (epoch %d)", cfg.model, cfg.model_dir, latest
         )
     else:
-        variables = jax.jit(model.init, static_argnames=("train",))(
-            jax.random.PRNGKey(cfg.seed),
-            jnp.zeros((1, seq_len), jnp.int32),
-            train=False,
-        )
-        params = nn.unbox(variables["params"])
         log.info("no MODEL_DIR: sampling from fresh seeded params")
+    params = state.params
 
     rng = np.random.RandomState(cfg.seed)
     batch = cfg.batch_size_per_device
