@@ -13,7 +13,8 @@ params, LayerNorm in f32, every weight annotated with logical axes
 (``LOGICAL_RULES`` there apply: heads/mlp → ``model`` for Megatron-style
 TP under the pjit engine).
 
-Input ``[B, T]`` int32 tokens → logits ``[B, T, vocab]`` f32; pair with
+Input ``[B, T]`` int32 tokens → logits ``[B, T, vocab]`` in the compute
+dtype (f32 loss math lives in the engine's CE/metrics); pair with
 shifted labels and the engine's generalized ``cross_entropy_loss``
 (per-token CE). ``data.SyntheticTokenDataset`` supplies the seeded
 synthetic stream (the ``FAKE=True`` contract, token edition).
@@ -66,7 +67,9 @@ class DecoderBlock(nn.Module):
 
 
 class TransformerLM(nn.Module):
-    """Causal LM over int32 token ids; returns f32 ``[B, T, vocab]``.
+    """Causal LM over int32 token ids; returns ``[B, T, vocab]`` logits
+    in the compute ``dtype`` (f32 accumulation inside the projection;
+    the loss/metric reductions upcast to f32 — ``train_step.py``).
 
     ``seq_axis``: set to the mesh's sequence axis name (``"seq"``) when
     the model runs *inside* a sequence-parallel ``shard_map``
@@ -202,16 +205,19 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Tied output projection (standard LM practice; halves embedding
         # params vs an untied head). Operands in the compute dtype so the
-        # MXU runs at full bf16 rate, accumulation and logits in f32
-        # (the standard LM mixed-precision recipe — the [B, T, V] logits
-        # tensor itself stays f32 for the CE).
+        # MXU runs at full bf16 rate with f32 accumulation; the [B, T, V]
+        # logits tensor is then STORED in the compute dtype (at vocab-32k
+        # it is the model's largest activation, and its cotangent — the
+        # projection backward's operand — stays bf16 too). The loss keeps
+        # one f32 copy internally (CE residual; see
+        # train_step._sparse_softmax_ce for the measured trade-off).
         logits = jnp.einsum(
             "btd,vd->btv",
             x.astype(self.dtype),
             embed.astype(self.dtype),
             preferred_element_type=jnp.float32,
         )
-        return logits
+        return logits.astype(self.dtype)
 
 
 LM_Tiny = functools.partial(TransformerLM, variant="tiny")
