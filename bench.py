@@ -378,9 +378,21 @@ def main():
         except Exception as e:  # OOM etc. → retry smaller batch
             last_err = e
             continue
-    print(json.dumps({"metric": "resnet50_synthetic_train_images_per_sec",
-                      "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
-                      "error": repr(last_err)}))
+    print(json.dumps({
+        # mirror the success path's metric naming so a failure is
+        # attributed to the protocol that actually ran
+        "metric": (
+            "resnet50_synthetic_train_images_per_sec"
+            if canonical
+            else (
+                f"{vision_model}_{image_size}px_images_per_sec"
+                if vision_model
+                else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
+            )
+        ),
+        "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+        "error": repr(last_err),
+    }))
     return 1
 
 

@@ -85,6 +85,9 @@ class MlpBlock(nn.Module):
 class Attention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
+    # "auto" resolves per call: the packed small-T Pallas kernel
+    # (ops/pallas/flash_packed.py) where it applies, XLA einsum otherwise.
+    # Explicit values ("xla" | "pallas" | "ring" | "fused") force a path.
     attn_impl: str = "xla"
     dropout: float = 0.0
     causal: bool = False  # decoder-only use (models/transformer_lm.py)
@@ -130,33 +133,65 @@ class Attention(nn.Module):
         probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 
+    def _resolve_impl(self, x, head_dim: int) -> str:
+        """``"auto"`` → the packed small-T kernel when the shape fits and
+        the call site is one where a Pallas custom call is safe: on-TPU
+        and either single-device or inside ``shard_map`` (the dp/sp
+        engines — operands are already local). Under multi-device GSPMD
+        (pjit engine) operands carry no varying axes; a custom call there
+        would force replication, so auto falls back to the einsum."""
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        from distributeddeeplearning_tpu.ops.pallas import flash_packed
+
+        local = bool(getattr(jax.typeof(x), "vma", ())) or jax.device_count() == 1
+        if (
+            x.ndim == 3
+            and jax.default_backend() == "tpu"
+            and flash_packed.supports(x.shape[1], self.num_heads, head_dim)
+            and local
+        ):
+            return "fused"
+        return "xla"
+
     @nn.compact
     def __call__(self, x, train: bool = True):
         d = x.shape[-1]
         head_dim = d // self.num_heads
-        qkv = _dense(3 * d, "qkv", ("embed", "heads"), self.dtype)(x)
-        qkv = qkv.reshape(*x.shape[:-1], 3, self.num_heads, head_dim)
-        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        if self.decode:
-            if not self.causal:
-                raise ValueError("decode=True requires causal attention")
-            out = self._decode_attention(q, k, v)
-        else:
-            # Params don't depend on the impl, and ring needs a bound mesh
-            # axis — init (traced outside shard_map) uses the xla path.
-            impl = self.attn_impl
-            if impl == "ring" and self.is_initializing():
-                impl = "xla"
-            out = dot_product_attention(
-                q,
-                k,
-                v,
-                causal=self.causal,
-                impl=impl,
-                axis_name=self.seq_axis,
+        qkv_flat = _dense(3 * d, "qkv", ("embed", "heads"), self.dtype)(x)
+        # Params don't depend on the impl, and ring needs a bound mesh
+        # axis — init (traced outside shard_map) uses the xla path.
+        impl = None if self.decode else self._resolve_impl(x, head_dim)
+        if impl == "ring" and self.is_initializing():
+            impl = "xla"
+        if impl == "fused":
+            # Packed path: no [B, T, 3, H, d] reshape/slice at the XLA
+            # level — the kernel reads head columns from qkv directly.
+            from distributeddeeplearning_tpu.ops.pallas.flash_packed import (
+                fused_qkv_attention,
             )
-        out = out.reshape(*x.shape[:-1], d)
-        out = _dense(d, "proj", ("heads", "embed"), self.dtype)(out)
+
+            out_flat = fused_qkv_attention(
+                qkv_flat, self.num_heads, causal=self.causal
+            )
+        else:
+            qkv = qkv_flat.reshape(*x.shape[:-1], 3, self.num_heads, head_dim)
+            q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+            if self.decode:
+                if not self.causal:
+                    raise ValueError("decode=True requires causal attention")
+                out = self._decode_attention(q, k, v)
+            else:
+                out = dot_product_attention(
+                    q,
+                    k,
+                    v,
+                    causal=self.causal,
+                    impl=impl,
+                    axis_name=self.seq_axis,
+                )
+            out_flat = out.reshape(*x.shape[:-1], d)
+        out = _dense(d, "proj", ("heads", "embed"), self.dtype)(out_flat)
         if self.dropout > 0:
             out = nn.Dropout(self.dropout, deterministic=not train)(out)
         return out
@@ -188,7 +223,9 @@ class ViT(nn.Module):
     patch_size: int = 16
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"
+    # "auto": packed small-T Pallas attention on TPU (T=197 is its
+    # regime — PROFILE.md round-4), XLA einsum elsewhere/otherwise.
+    attn_impl: str = "auto"
     dropout: float = 0.0
     # Gradient checkpointing: recompute block activations in backward
     # (REMAT=1 via config) — O(depth) activation memory for one extra fwd.

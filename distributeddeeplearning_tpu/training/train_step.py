@@ -207,14 +207,16 @@ def flat_axis_index(mesh: Mesh, axes) -> jnp.ndarray:
 
 
 def _pallas_interpreted(model) -> bool:
-    """True when this model's attention would run the Pallas kernel in
+    """True when this model's attention would run a Pallas kernel in
     interpreter mode (non-TPU backend): the HLO interpreter's internal
     slicing trips shard_map's varying-axes checker (upstream limitation;
     its own error message recommends check_vma=False), so the engines
     drop the check for exactly this case. The compiled TPU path keeps
-    checking on — verified on hardware."""
+    checking on — verified on hardware. Covers both explicit kernel
+    impls ("pallas" = streaming flash, "fused" = packed small-T); "auto"
+    resolves to "xla" off-TPU (models/vit.py) and needs no exception."""
     return (
-        getattr(model, "attn_impl", None) == "pallas"
+        getattr(model, "attn_impl", None) in ("pallas", "fused")
         and jax.default_backend() != "tpu"
     )
 

@@ -1,0 +1,76 @@
+"""Summarize a jax.profiler trace: device-lane op durations grouped by
+fusion-name prefix, so PROFILE.md's per-op tables can be reproduced.
+
+Usage: python scripts/trace_summary.py /tmp/trace_dir [top_n]
+Finds the newest ``*.trace.json.gz`` under the directory, keeps complete
+events on TensorCore/XLA-op tracks, strips trailing digits/dots from op
+names (``fusion.123`` → ``fusion``), and prints total ms and counts per
+group, normalized per step when the number of profiled steps is known
+(``TRACE_STEPS``, default bench.py's 20).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def load_events(trace_dir: str):
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        raise SystemExit(f"no *.trace.json.gz under {trace_dir}")
+    with gzip.open(paths[-1], "rt") as fh:
+        return json.load(fh), paths[-1]
+
+
+def main():
+    trace_dir = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    steps = int(os.environ.get("TRACE_STEPS", "20"))
+    data, path = load_events(trace_dir)
+    events = data["traceEvents"]
+
+    # pid -> process name; keep TensorCore-ish lanes (XLA ops run there).
+    proc = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc[e["pid"]] = e["args"].get("name", "")
+    device_pids = {
+        p for p, n in proc.items()
+        if "TPU" in n or "Tensor" in n or "/device" in n.lower()
+    }
+
+    groups = collections.defaultdict(lambda: [0.0, 0])
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "")
+        # thread-level lanes include steps/modules; skip the module-level
+        # envelope events (they'd double-count their children)
+        if name.startswith("jit_") or name.startswith("Steps"):
+            continue
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        key = re.sub(r"[.\d]+$", "", name)
+        groups[key][0] += dur
+        groups[key][1] += 1
+        total += dur
+
+    print(f"# {path}")
+    print(f"# total device op time: {total:.1f} ms "
+          f"({total / steps:.1f} ms/step over {steps} steps)")
+    print(f"{'group':55s} {'ms/step':>9s} {'count':>7s} {'%':>6s}")
+    for key, (ms, cnt) in sorted(groups.items(), key=lambda kv: -kv[1][0])[:top_n]:
+        print(f"{key:55s} {ms / steps:9.2f} {cnt:7d} {100 * ms / total:5.1f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -143,3 +143,76 @@ def test_flash_bwd_kernels_match_scan_reference(causal):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, err_msg=name
         )
+
+
+# ---- packed small-T kernel (ops/pallas/flash_packed.py) ----
+
+from distributeddeeplearning_tpu.ops.pallas.flash_packed import (  # noqa: E402
+    fused_qkv_attention,
+    supports,
+)
+
+
+def _packed_ref(qkv, heads, causal):
+    """Independent einsum reference for the packed layout."""
+    b, t, thd = qkv.shape
+    d = thd // 3 // heads
+    q, k, v = [x.reshape(b, t, heads, d) for x in jnp.split(qkv, 3, -1)]
+    out = dot_product_attention(q, k, v, causal=causal, impl="xla")
+    return out.reshape(b, t, heads * d)
+
+
+@pytest.mark.parametrize(
+    "b,t,h,d,causal",
+    [
+        (4, 29, 2, 64, False),  # ragged T, two heads per 128-lane block
+        (2, 29, 2, 64, True),
+        (2, 16, 1, 128, True),  # one head per block
+        (3, 48, 4, 32, False),  # four heads per block
+    ],
+)
+def test_packed_matches_xla(b, t, h, d, causal):
+    rng = np.random.RandomState(0)
+    qkv = jnp.asarray(rng.randn(b, t, 3 * h * d).astype(np.float32))
+    out = fused_qkv_attention(qkv, h, causal=causal, interpret=True)
+    ref = _packed_ref(qkv, h, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_grads_match_xla(causal):
+    rng = np.random.RandomState(1)
+    qkv = jnp.asarray(rng.randn(2, 29, 3 * 2 * 64).astype(np.float32))
+
+    def loss(fn):
+        return lambda x: jnp.sum(jnp.sin(fn(x)))
+
+    g = jax.grad(
+        loss(lambda x: fused_qkv_attention(x, 2, causal=causal, interpret=True))
+    )(qkv)
+    g_ref = jax.grad(loss(lambda x: _packed_ref(x, 2, causal)))(qkv)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+
+def test_packed_ragged_tail_is_finite():
+    """The unpadded ragged tail must be sanitised in-kernel: gradients
+    through every contraction over T stay finite (a poisoned tail row
+    would NaN dq/dk/dv)."""
+    rng = np.random.RandomState(2)
+    qkv = jnp.asarray(rng.randn(2, 17, 3 * 2 * 64).astype(np.float32))
+    g = jax.grad(
+        lambda x: jnp.sum(fused_qkv_attention(x, 2, interpret=True))
+    )(qkv)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_packed_supports_gating():
+    assert supports(197, 12, 64)
+    assert supports(512, 16, 128)
+    # long T is the streaming kernel's regime — and at 1024 the ~6 live
+    # [T, T] f32 intermediates alone exceed the scoped-VMEM budget
+    assert not supports(1024, 16, 128)
+    assert not supports(2048, 12, 64)
+    assert not supports(197, 3, 64)  # 3 heads don't fill 128-lane blocks
+    with pytest.raises(ValueError):
+        fused_qkv_attention(jnp.zeros((1, 8, 3 * 3 * 64)), 3, interpret=True)

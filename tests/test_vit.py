@@ -191,3 +191,52 @@ def test_get_model_attn_impl_plumbing():
     # conv models ignore the knob instead of crashing
     r = get_model("resnet18", num_classes=10, attn_impl="pallas")
     assert r.depth == 18
+
+
+def test_vit_fused_packed_attention_matches_xla(mesh8):
+    """attn_impl='fused' (packed small-T kernel, interpreter mode on CPU)
+    equals the XLA einsum path from the same params — the path the TPU
+    'auto' default takes for ViT shapes (PROFILE.md round-4) — and trains
+    a DP step. variant='s' because the packed kernel needs whole
+    128-lane head groups (6 heads × d=64; 'ti' has 3 heads)."""
+    img = np.random.RandomState(0).randn(16, 32, 32, 3).astype(np.float32)
+    lbl = np.random.RandomState(1).randint(0, 10, size=(16,)).astype(np.int32)
+
+    def build(impl):
+        return ViT(
+            variant="s", patch_size=8, num_classes=10,
+            dtype=jnp.float32, attn_impl=impl, dropout=0.0,
+        )
+
+    m_xla, m_fused = build("xla"), build("fused")
+    tx = optax.sgd(0.05)
+    state = create_train_state(m_xla, CFG, tx, input_shape=(1, 32, 32, 3))
+    logits_xla = m_xla.apply(
+        {"params": state.params, "batch_stats": {}}, img, train=False
+    )
+    logits_fused = m_fused.apply(
+        {"params": state.params, "batch_stats": {}}, img, train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_fused), np.asarray(logits_xla), atol=2e-4
+    )
+    state = replicate_state(state, mesh8)
+    # default check_vma: _pallas_interpreted covers impl='fused' off-TPU
+    step = make_train_step(m_fused, tx, mesh8, CFG, donate_state=False)
+    new_state, metrics = step(state, shard_batch((img, lbl), mesh8))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(new_state.step) == 1
+
+
+def test_vit_auto_impl_resolves_to_xla_off_tpu():
+    """The 'auto' default must not select the Pallas kernel on non-TPU
+    backends: logits equal the explicit-xla build bit-for-bit."""
+    img = np.random.RandomState(0).randn(4, 32, 32, 3).astype(np.float32)
+    m_auto = ViT(variant="s", patch_size=8, num_classes=10,
+                 dtype=jnp.float32, attn_impl="auto", dropout=0.0)
+    m_xla = ViT(variant="s", patch_size=8, num_classes=10,
+                dtype=jnp.float32, attn_impl="xla", dropout=0.0)
+    variables = m_xla.init(jax.random.PRNGKey(0), img[:1], train=False)
+    a = m_auto.apply(variables, img, train=False)
+    b = m_xla.apply(variables, img, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
