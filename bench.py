@@ -314,6 +314,17 @@ def main():
         # metric name + vs_baseline instead of demoting the run
         vision_model = None
     canonical = depth == 50 and image_size == 224 and not vision_model
+    # ONE metric name for success and failure records — the protocol that
+    # ran must be attributable either way
+    metric = (
+        "resnet50_synthetic_train_images_per_sec"
+        if canonical
+        else (
+            f"{vision_model}_{image_size}px_images_per_sec"
+            if vision_model
+            else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
+        )
+    )
     bench_kw = dict(model_name=vision_model, depth=depth, image_size=image_size)
     for per_device_batch in batches:
         try:
@@ -352,15 +363,7 @@ def main():
             print(
                 json.dumps(
                     {
-                        "metric": (
-                            "resnet50_synthetic_train_images_per_sec"
-                            if canonical
-                            else (
-                                f"{vision_model}_{image_size}px_images_per_sec"
-                                if vision_model
-                                else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
-                            )
-                        ),
+                        "metric": metric,
                         "value": round(ips, 1),
                         "unit": "images/sec",
                         # vs_baseline only means something for the
@@ -379,17 +382,7 @@ def main():
             last_err = e
             continue
     print(json.dumps({
-        # mirror the success path's metric naming so a failure is
-        # attributed to the protocol that actually ran
-        "metric": (
-            "resnet50_synthetic_train_images_per_sec"
-            if canonical
-            else (
-                f"{vision_model}_{image_size}px_images_per_sec"
-                if vision_model
-                else f"resnet{depth}_{image_size}px_smoke_images_per_sec"
-            )
-        ),
+        "metric": metric,
         "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
         "error": repr(last_err),
     }))

@@ -1,0 +1,243 @@
+"""Depthwise convolution as Pallas TPU kernels (the EfficientNet regime).
+
+XLA:TPU lowers ``conv_general_dilated`` with ``feature_group_count=C``
+very poorly: measured 7-18 % of the HBM roofline for EfficientNet-B4's
+depthwise layers (fwd+bwd, PROFILE.md round-4) — ~83 ms of a 168 ms
+train step. A depthwise conv is *not* a matmul: per output element it
+does k² multiply-adds per channel, so the MXU has nothing to contract
+and the right home is the VPU with the activation resident in VMEM.
+
+Kernel shape: grid ``(B/nb,)`` — each program holds ``nb`` whole
+``[H, W, C]`` images in VMEM (every EfficientNet-B4 stride-1 depthwise
+layer fits; ``supports()`` checks). Compute runs in row strips: each
+strip builds its small zero-padded window, accumulates the k² taps in
+f32, and writes back — the full-image padded copy and full-image f32
+accumulator of the naive formulation would blow VMEM at 112².
+
+Backward is TWO kernels rather than one sharing the ``dy`` read:
+* dgrad — the same stencil on ``dy`` with spatially-flipped taps
+  (needs only ``dy``);
+* wgrad — ``dw[di,dj,c] = Σ_{b,i,j} xpad[i+di, j+dj, c]·dy[i,j,c]``,
+  per-program partials ``[B/nb, k², C]`` summed by one tiny XLA
+  reduction (keeps the grid parallel).
+Sharing the read would save one pass over ``dy`` (~0.2 GB across all
+32 layers, ≈0.25 ms) but pushes the 112² layers over the 16 MB
+scoped-VMEM limit — measured not worth it.
+
+Only stride 1 / SAME / odd-k is handled — 28 of EfficientNet-B4's 32
+depthwise layers; the four stride-2 stage transitions stay on XLA
+(``models/efficientnet.py`` gates per layer).
+
+Params are bit-compatible with ``nn.Conv(feature_group_count=C)``: the
+wrapper module (``models/efficientnet.DepthwiseConv``) creates the
+identical ``kernel`` param ``[k, k, 1, C]``, so checkpoints are
+unaffected by the impl choice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributeddeeplearning_tpu.ops.pallas.flash import _ceil_to, _vma
+
+_LANES = 128
+_STRIP = 16  # output rows per in-kernel strip
+# These kernels ask the compiler for a raised scoped-VMEM ceiling
+# (vmem_limit_bytes): the whole-image blocks at 112² need ~18 MB, over
+# the default 16 MB scope but far under the chip's physical VMEM. nb
+# still prefers configurations inside the default scope.
+_VMEM_PREF = 15 * 2**20
+_VMEM_LIMIT = 32 * 2**20
+
+
+def _img_bytes(h: int, w: int, c: int, itemsize: int = 2) -> int:
+    return h * w * _ceil_to(c, _LANES) * itemsize
+
+
+def _vmem_bytes(nb: int, h: int, w: int, c: int, k: int) -> int:
+    """Worst kernel (fwd/dgrad): double-buffered image input and output
+    plus strip-sized temporaries (padded window + f32 accumulator), with
+    15 % slack for Mosaic temporaries."""
+    p = (k - 1) // 2
+    img = nb * _img_bytes(h, w, c)
+    window = _img_bytes(_STRIP + 2 * p, w + 2 * p, c)
+    strip = _img_bytes(_STRIP, w, c, 4)
+    return int((2 * img + 2 * img + 2 * (window + strip)) * 1.15)
+
+
+def _batch_per_block(batch: int, h: int, w: int, c: int, k: int) -> int:
+    for limit in (_VMEM_PREF, _VMEM_LIMIT):
+        for nb in (8, 4, 2, 1):
+            if batch % nb == 0 and _vmem_bytes(nb, h, w, c, k) <= limit:
+                return nb
+    return 1
+
+
+def supports(h: int, w: int, c: int, k: int, stride: int) -> bool:
+    """Stride-1 SAME odd-k depthwise layers whose image fits VMEM.
+    Batch-independent: ``_batch_per_block`` degrades to nb=1, so only
+    the single-image footprint gates eligibility."""
+    return (
+        stride == 1
+        and k % 2 == 1
+        and k > 1
+        and h >= k
+        and w >= k
+        and _vmem_bytes(1, h, w, c, k) <= _VMEM_LIMIT
+    )
+
+
+def _window(x, s0: int, s: int, p: int):
+    """Zero-padded input window for output rows [s0, s0+s): rows
+    [s0-p, s0+s+p) of ``x`` with out-of-range rows and the W edges
+    zero-filled. All slice bounds are static (the strip loop unrolls)."""
+    h = x.shape[0]
+    lo, hi = s0 - p, s0 + s + p
+    core = x[max(lo, 0) : min(hi, h)]
+    return jnp.pad(
+        core, ((max(0, -lo), max(0, hi - h)), (p, p), (0, 0))
+    )
+
+
+def _stencil_strip(win, wt, s: int, w: int, k: int):
+    """Σ over k² taps of wt[di·k+dj, c] · win[di+i, dj+j, c] for an
+    [s, w] output strip, f32 accumulation. ``win`` must already be f32:
+    converting per tap (k² converts per element) measurably dominated
+    the VPU time of the first cut."""
+    acc = jnp.zeros((s, w, win.shape[-1]), jnp.float32)
+    for di in range(k):
+        for dj in range(k):
+            tap = win[di : di + s, dj : dj + w, :]
+            acc = acc + tap * wt[di * k + dj][None, None, :]
+    return acc
+
+
+def _conv_kernel(x_ref, w_ref, y_ref, *, k: int, nb: int):
+    """One stencil kernel serves forward and dgrad: the transposed
+    stencil is the same stencil with spatially-reversed taps, and the
+    caller passes the tap table pre-flipped (Mosaic has no ``rev``)."""
+    p = (k - 1) // 2
+    wt = w_ref[...].astype(jnp.float32)
+    for n in range(nb):
+        x = x_ref[n]
+        h, w, _ = x.shape
+        for s0 in range(0, h, _STRIP):
+            s = min(_STRIP, h - s0)
+            win = _window(x, s0, s, p).astype(jnp.float32)
+            y_ref[n, s0 : s0 + s] = _stencil_strip(win, wt, s, w, k).astype(
+                y_ref.dtype
+            )
+
+
+def _wgrad_kernel(x_ref, dy_ref, dw_ref, *, k: int, nb: int):
+    p = (k - 1) // 2
+    c = x_ref.shape[-1]
+    sums = [jnp.zeros((c,), jnp.float32) for _ in range(k * k)]
+    for n in range(nb):
+        x = x_ref[n]
+        h, w, _ = x.shape
+        for s0 in range(0, h, _STRIP):
+            s = min(_STRIP, h - s0)
+            win = _window(x, s0, s, p).astype(jnp.float32)
+            dy = dy_ref[n, s0 : s0 + s].astype(jnp.float32)
+            for di in range(k):
+                for dj in range(k):
+                    tap = win[di : di + s, dj : dj + w, :]
+                    sums[di * k + dj] = sums[di * k + dj] + jnp.sum(
+                        tap * dy, axis=(0, 1)
+                    )
+    dw_ref[0] = jnp.stack(sums)
+
+
+def _img_spec(nb, h, w, c):
+    return pl.BlockSpec((nb, h, w, c), lambda i: (i, 0, 0, 0))
+
+
+def _params():
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",), vmem_limit_bytes=_VMEM_LIMIT
+    )
+
+
+def _run_conv(x, wt, k, flip, interpret):
+    b, h, w, c = x.shape
+    nb = _batch_per_block(b, h, w, c, k)
+    if flip:
+        wt = wt[::-1]  # XLA-side: a [k², C] reverse, trivial
+    return pl.pallas_call(
+        functools.partial(_conv_kernel, k=k, nb=nb),
+        grid=(b // nb,),
+        in_specs=[
+            _img_spec(nb, h, w, c),
+            pl.BlockSpec((k * k, c), lambda i: (0, 0)),
+        ],
+        out_specs=_img_spec(nb, h, w, c),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), x.dtype, vma=_vma(x, wt)),
+        compiler_params=_params(),
+        interpret=interpret,
+    )(x, wt)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _depthwise(x, wt, interpret):
+    k = int(round(wt.shape[0] ** 0.5))
+    return _run_conv(x, wt, k, False, interpret)
+
+
+def _depthwise_fwd(x, wt, interpret):
+    return _depthwise(x, wt, interpret), (x, wt)
+
+
+def _depthwise_bwd(interpret, res, dy):
+    x, wt = res
+    k = int(round(wt.shape[0] ** 0.5))
+    b, h, w, c = x.shape
+    nb = _batch_per_block(b, h, w, c, k)
+    dx = _run_conv(dy, wt, k, True, interpret)
+    dw_parts = pl.pallas_call(
+        functools.partial(_wgrad_kernel, k=k, nb=nb),
+        grid=(b // nb,),
+        in_specs=[_img_spec(nb, h, w, c), _img_spec(nb, h, w, c)],
+        out_specs=pl.BlockSpec((1, k * k, c), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (b // nb, k * k, c), jnp.float32, vma=_vma(x, wt, dy)
+        ),
+        compiler_params=_params(),
+        interpret=interpret,
+    )(x, dy)
+    return dx, jnp.sum(dw_parts, axis=0)
+
+
+_depthwise.defvjp(_depthwise_fwd, _depthwise_bwd)
+
+
+def depthwise_conv2d(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Stride-1 SAME depthwise conv over NHWC ``x`` with an
+    ``nn.Conv``-layout ``[k, k, 1, C]`` kernel. Use :func:`supports`
+    first; stride-2 / even-k / VMEM-overflow shapes belong to XLA."""
+    if x.ndim != 4:
+        raise ValueError(f"expected NHWC, got {x.shape}")
+    k, k2, one, c = kernel.shape
+    if k != k2 or one != 1 or c != x.shape[-1]:
+        raise ValueError(
+            f"expected [k, k, 1, C={x.shape[-1]}], got {kernel.shape}"
+        )
+    if not supports(x.shape[1], x.shape[2], c, k, 1):
+        raise ValueError(f"unsupported depthwise shape {x.shape} k={k}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # [k², C] f32 tap table: the dtype the accumulator uses anyway, and
+    # a layout whose rows are the static taps the kernels index.
+    wt = kernel.reshape(k * k, c).astype(jnp.float32)
+    return _depthwise(x, wt, interpret)

@@ -73,26 +73,28 @@ def heads_per_block(head_dim: int) -> int:
     return max(1, _LANES // head_dim)
 
 
-def _bwd_vmem_bytes(nb: int, tp: int) -> int:
+def _bwd_vmem_bytes(nb: int, tp: int, width: int = _LANES) -> int:
     """Backward-pass scoped-VMEM estimate (the fwd needs strictly less):
     5 double-buffered bf16 input blocks + the double-buffered output +
     3 f32 scratch blocks + ~6 live [T, T] f32 score intermediates, with
-    30 % slack for Mosaic temporaries. Calibration: the nb=16, Tp=208
-    configuration this formula puts at 16.4 MB pre-slack was measured by
-    Mosaic at 16.2 MB (over the limit); nb=8 (8.7 MB pre-slack) fits."""
-    rows = nb * tp * _LANES
+    30 % slack for Mosaic temporaries. ``width`` is the block lane width
+    hp·d (= 128 for d ≤ 128; = d for wider heads). Calibration: the
+    nb=16, Tp=208, width=128 configuration this formula puts at 16.4 MB
+    pre-slack was measured by Mosaic at 16.2 MB (over the limit); nb=8
+    (8.7 MB pre-slack) fits."""
+    rows = nb * tp * width
     blocks = 5 * 2 * rows * 2 + 2 * rows * 2 + 3 * rows * 4
     scores = 6 * tp * tp * 4
     return int((blocks + scores) * 1.3)
 
 
-def _batch_per_block(batch: int, seq_len: int) -> int:
+def _batch_per_block(batch: int, seq_len: int, width: int = _LANES) -> int:
     """Samples per program: enough to amortise per-program dispatch/DMA
     overhead (1 sample/program measured ~12 µs-dominated), small enough
     that the backward stays under the scoped-VMEM limit."""
     tp = _ceil_to(seq_len, 16)
     for nb in (8, 4, 2, 1):
-        if batch % nb == 0 and _bwd_vmem_bytes(nb, tp) <= _VMEM_BUDGET:
+        if batch % nb == 0 and _bwd_vmem_bytes(nb, tp, width) <= _VMEM_BUDGET:
             return nb
     return 1
 
@@ -105,7 +107,8 @@ def supports(seq_len: int, num_heads: int, head_dim: int) -> bool:
         seq_len <= MAX_T
         and num_heads % hp == 0
         and (head_dim % _LANES == 0 or _LANES % head_dim == 0)
-        and _bwd_vmem_bytes(1, _ceil_to(seq_len, 16)) <= _VMEM_BUDGET
+        and _bwd_vmem_bytes(1, _ceil_to(seq_len, 16), hp * head_dim)
+        <= _VMEM_BUDGET
     )
 
 
@@ -230,7 +233,8 @@ def _geometry(qkv, heads):
     hd = three_hd // 3
     d = hd // heads
     hp = heads_per_block(d)
-    return b, t, hd, d, hp, hp * d, heads // hp, _batch_per_block(b, t)
+    w = hp * d
+    return b, t, hd, d, hp, w, heads // hp, _batch_per_block(b, t, w)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))

@@ -1,0 +1,75 @@
+"""Parity oracle for the Pallas depthwise-conv experiment.
+
+``ops/pallas/depthwise.py`` is a recorded NEGATIVE result (PROFILE.md
+round-4): three kernel designs measured slower than or equal to XLA's
+own (bad) depthwise lowering, so the model does NOT use it. The kernels
+stay exact — these tests pin them to ``lax.conv_general_dilated``
+(fwd + both grads) so the experiment remains trustworthy evidence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from distributeddeeplearning_tpu.ops.pallas.depthwise import (
+    depthwise_conv2d,
+    supports,
+)
+
+
+def _ref(x, kernel):
+    return lax.conv_general_dilated(
+        x.astype(jnp.float32),
+        kernel.astype(jnp.float32),
+        (1, 1),
+        "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=x.shape[-1],
+    )
+
+
+@pytest.mark.parametrize(
+    "b,h,w,c,k",
+    [
+        (2, 13, 11, 8, 3),  # ragged spatial dims, both edges masked
+        (2, 9, 9, 8, 5),
+        (1, 16, 16, 130, 3),  # C straddles a lane-tile boundary
+    ],
+)
+def test_depthwise_matches_lax(b, h, w, c, k):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(b, h, w, c).astype(np.float32))
+    kern = jnp.asarray(rng.randn(k, k, 1, c).astype(np.float32))
+    out = depthwise_conv2d(x, kern, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(x, kern)), atol=1e-4
+    )
+
+
+def test_depthwise_grads_match_lax():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 9, 9, 8).astype(np.float32))
+    kern = jnp.asarray(rng.randn(3, 3, 1, 8).astype(np.float32))
+
+    def loss(fn):
+        return lambda x, kk: jnp.sum(jnp.sin(fn(x, kk)))
+
+    g = jax.grad(
+        loss(lambda x, kk: depthwise_conv2d(x, kk, interpret=True)),
+        argnums=(0, 1),
+    )(x, kern)
+    g_ref = jax.grad(loss(_ref), argnums=(0, 1))(x, kern)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(g_ref[0]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(g_ref[1]), atol=1e-4)
+
+
+def test_depthwise_supports_gating():
+    assert supports(28, 28, 336, 5, 1)
+    assert not supports(28, 28, 336, 5, 2)  # stride-2: XLA's
+    assert not supports(28, 28, 336, 4, 1)  # even k
+    with pytest.raises(ValueError):
+        depthwise_conv2d(
+            jnp.zeros((1, 8, 8, 4)), jnp.zeros((4, 4, 1, 4)), interpret=True
+        )
