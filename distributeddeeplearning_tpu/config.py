@@ -130,11 +130,12 @@ class TrainConfig:
     # over a 'model'/'expert' axis — the default), "fsdp" (ZeRO-3:
     # weights sharded over the data axis itself), "dp" (replicated).
     param_sharding: str = "tp"
-    # BatchNorm semantics guard: the pjit engine computes GLOBAL-batch
-    # (sync) BN statistics, while the dp engine keeps the reference's
-    # per-replica stats. A batch_stats-carrying model under ENGINE=pjit
-    # is refused unless this opt-in acknowledges the semantics change
-    # (checkpoints trained under the two engines are not comparable).
+    # BatchNorm semantics under ENGINE=pjit: by default the train step
+    # batch-splits BN statistics per data shard (models/norm.py), which
+    # equals the dp engine's (and the reference's) per-replica BN —
+    # oracle-tested. This opt-in switches to GLOBAL-batch (sync-BN)
+    # statistics instead (and is required for ResNet(fused=True), whose
+    # in-kernel statistics cannot be batch-split).
     allow_sync_bn: bool = False
 
     # Bookkeeping

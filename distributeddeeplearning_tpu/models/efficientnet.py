@@ -56,7 +56,9 @@ def _round_repeats(repeats: int, depth_mult: float) -> int:
 
 
 def _bn(train, dtype, name=None):
-    return nn.BatchNorm(
+    from distributeddeeplearning_tpu.models.norm import BatchNorm
+
+    return BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-3,
@@ -141,6 +143,12 @@ class EfficientNet(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     survival_prob: float = 0.8
+
+    @property
+    def per_replica_bn_capable(self) -> bool:
+        """Every BN is the group-capable subclass (models/norm.py): the
+        pjit engine's batch-split per-replica BN applies."""
+        return True
 
     @property
     def default_image_size(self) -> int:

@@ -1,0 +1,141 @@
+"""BatchNorm with per-replica semantics under any engine.
+
+SURVEY.md §7 hard part (b): the reference's Horovod training normalizes
+every worker's activations with that worker's LOCAL batch statistics
+(non-sync BN). The shard_map (dp) engine reproduces this for free —
+``nn.BatchNorm`` runs on the local shard. Under the pjit engine the
+model sees the GLOBAL batch, so a plain ``nn.BatchNorm``'s reductions
+become sync-BN: different training semantics, non-comparable
+checkpoints. Round 3 refused BN models under pjit; this module closes
+the gap (VERDICT r3 #4) with *batch-split* BN:
+
+* :func:`per_replica_bn` (a trace-time context, entered by
+  ``make_pjit_train_step`` around the forward) declares how many
+  data shards the global batch is split across.
+* :class:`BatchNorm` — inside that context, with G > 1 groups, it
+  reshapes ``[B, ...]`` to ``[G, B/G, ...]`` and computes statistics
+  per group. The group axis is annotated with the ``batch`` logical
+  axis, so under GSPMD each group's reduction is local to its data
+  shard — no cross-shard stats collectives. Each group's rows match
+  exactly the rows the dp engine would place on one device
+  (``shard_batch`` shards the leading axis contiguously), so the
+  math equals the dp engine's per-replica BN.
+* Running statistics update with the across-group mean of the group
+  statistics — exactly the dp engine's ``pmean`` of per-replica
+  updates (``training/train_step.py``), keeping state device-invariant.
+
+The class is deliberately named ``BatchNorm``: flax auto-names modules
+by class name, so the parameter/batch_stats tree stays ``BatchNorm_k``
+— bit-compatible with ``nn.BatchNorm`` checkpoints and with the fused
+block's ``_SplitBN`` name matching (``models/resnet.py``). Outside the
+context (G == 1), at init, and in eval mode it defers to
+``nn.BatchNorm`` unchanged. The grouped statistics/normalization reuse
+flax's own ``_compute_stats`` / ``_normalize`` so the per-group math is
+the same code path the dp engine runs per shard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import module as flax_module
+from flax.linen import normalization as flax_norm
+
+_GROUPS = 1
+
+
+@contextlib.contextmanager
+def per_replica_bn(groups: int):
+    """Trace-time context: BatchNorm computes statistics per batch-split
+    group (one group per data shard). ``groups=1`` is a no-op."""
+    global _GROUPS
+    prev = _GROUPS
+    _GROUPS = int(groups)
+    try:
+        yield
+    finally:
+        _GROUPS = prev
+
+
+def active_groups() -> int:
+    return _GROUPS
+
+
+class BatchNorm(nn.BatchNorm):
+    """``nn.BatchNorm`` with batch-split per-replica statistics when a
+    :func:`per_replica_bn` context is active (see module docstring).
+    Only the default ``axis=-1`` feature layout participates in
+    grouping; anything else defers to the flax implementation."""
+
+    @nn.compact
+    def __call__(self, x, use_running_average=None, *, mask=None):
+        use_ra = flax_module.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        groups = _GROUPS
+        if (
+            groups <= 1
+            or use_ra
+            or self.is_initializing()
+            or mask is not None
+            or self.axis != -1
+            # explicit cross-device stat sync requested — honour it
+            or self.axis_name is not None
+            or self.axis_index_groups is not None
+            or x.ndim < 2
+            or x.shape[0] % groups
+        ):
+            return super().__call__(
+                x, use_running_average=use_running_average, mask=mask
+            )
+
+        xg = x.reshape(groups, x.shape[0] // groups, *x.shape[1:])
+        # Pin the group axis to the batch mesh axes: each group's
+        # statistics reduction stays local to its data shard.
+        xg = nn.with_logical_constraint(
+            xg, ("batch",) + (None,) * (xg.ndim - 1)
+        )
+        reduction_axes = tuple(range(1, xg.ndim - 1))
+        mean, var = flax_norm._compute_stats(
+            xg,
+            reduction_axes,
+            dtype=self.dtype,
+            use_fast_variance=self.use_fast_variance,
+            force_float32_reductions=self.force_float32_reductions,
+        )  # [G, C] each
+
+        stats_dtype = (
+            jnp.float32 if self.force_float32_reductions else self.param_dtype
+        )
+        c = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), stats_dtype)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), stats_dtype)
+        )
+        m = self.momentum
+        # = the dp engine's pmean over per-replica updated stats.
+        ra_mean.value = m * ra_mean.value + (1 - m) * jnp.mean(mean, axis=0)
+        ra_var.value = m * ra_var.value + (1 - m) * jnp.mean(var, axis=0)
+
+        y = flax_norm._normalize(
+            self,
+            xg,
+            mean,
+            var,
+            reduction_axes,
+            (xg.ndim - 1,),
+            self.dtype,
+            self.param_dtype,
+            self.epsilon,
+            self.use_bias,
+            self.use_scale,
+            self.bias_init,
+            self.scale_init,
+            self.force_float32_reductions,
+        )
+        return y.reshape(x.shape)

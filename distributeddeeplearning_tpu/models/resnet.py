@@ -98,8 +98,14 @@ def _batch_norm(
     batch-statistics reduction (PROFILE.md roadmap item 2 — measured a
     no-win on v5e, and its fast-variance form cancels catastrophically
     for channels with std ≪ |mean| in bf16; default stays f32).
+
+    Uses the per-replica-capable subclass (``models/norm.py``): the
+    pjit engine's batch-split grouping engages through it, the dp
+    engine sees plain ``nn.BatchNorm`` behavior.
     """
-    return nn.BatchNorm(
+    from distributeddeeplearning_tpu.models.norm import BatchNorm
+
+    return BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=_BN_EPS,
@@ -315,6 +321,13 @@ class ResNet(nn.Module):
     # Fused Pallas bottleneck segments (see BottleneckBlock.fused);
     # ignored for the basic-block depths.
     fused: bool = False
+
+    @property
+    def per_replica_bn_capable(self) -> bool:
+        """The pjit engine's batch-split per-replica BN (models/norm.py)
+        works through every BN here EXCEPT the fused experiment's
+        in-kernel statistics (``_SplitBN`` takes pre-reduced moments)."""
+        return not self.fused
 
     @nn.compact
     def __call__(self, x, train: bool = True):

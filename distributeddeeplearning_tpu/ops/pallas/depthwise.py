@@ -24,14 +24,14 @@ Sharing the read would save one pass over ``dy`` (~0.2 GB across all
 32 layers, ≈0.25 ms) but pushes the 112² layers over the 16 MB
 scoped-VMEM limit — measured not worth it.
 
-Only stride 1 / SAME / odd-k is handled — 28 of EfficientNet-B4's 32
-depthwise layers; the four stride-2 stage transitions stay on XLA
-(``models/efficientnet.py`` gates per layer).
-
-Params are bit-compatible with ``nn.Conv(feature_group_count=C)``: the
-wrapper module (``models/efficientnet.DepthwiseConv``) creates the
-identical ``kernel`` param ``[k, k, 1, C]``, so checkpoints are
-unaffected by the impl choice.
+Only stride 1 / SAME / odd-k is handled — that would be 28 of
+EfficientNet-B4's 32 depthwise layers. **The model does NOT use this
+kernel**: every design here measured slower than (or equal to) XLA's
+own lowering, so it is kept flag-off as the recorded experiment — see
+PROFILE.md "round 4: EfficientNet — the depthwise ceiling" for the
+measurements and the Mosaic VMEM-round-trip diagnosis. The kernel takes
+the ``nn.Conv(feature_group_count=C)`` kernel layout ``[k, k, 1, C]``
+unchanged, so wiring it in later would not touch checkpoints.
 """
 
 from __future__ import annotations
@@ -60,26 +60,31 @@ def _img_bytes(h: int, w: int, c: int, itemsize: int = 2) -> int:
     return h * w * _ceil_to(c, _LANES) * itemsize
 
 
-def _vmem_bytes(nb: int, h: int, w: int, c: int, k: int) -> int:
+def _vmem_bytes(nb: int, h: int, w: int, c: int, k: int, itemsize: int = 2) -> int:
     """Worst kernel (fwd/dgrad): double-buffered image input and output
     plus strip-sized temporaries (padded window + f32 accumulator), with
-    15 % slack for Mosaic temporaries."""
+    15 % slack for Mosaic temporaries. ``itemsize`` is the activation
+    dtype's (2 = bf16; f32 inputs double the image blocks)."""
     p = (k - 1) // 2
-    img = nb * _img_bytes(h, w, c)
-    window = _img_bytes(_STRIP + 2 * p, w + 2 * p, c)
+    img = nb * _img_bytes(h, w, c, itemsize)
+    window = _img_bytes(_STRIP + 2 * p, w + 2 * p, c, 4)
     strip = _img_bytes(_STRIP, w, c, 4)
     return int((2 * img + 2 * img + 2 * (window + strip)) * 1.15)
 
 
-def _batch_per_block(batch: int, h: int, w: int, c: int, k: int) -> int:
+def _batch_per_block(
+    batch: int, h: int, w: int, c: int, k: int, itemsize: int = 2
+) -> int:
     for limit in (_VMEM_PREF, _VMEM_LIMIT):
         for nb in (8, 4, 2, 1):
-            if batch % nb == 0 and _vmem_bytes(nb, h, w, c, k) <= limit:
+            if batch % nb == 0 and _vmem_bytes(nb, h, w, c, k, itemsize) <= limit:
                 return nb
     return 1
 
 
-def supports(h: int, w: int, c: int, k: int, stride: int) -> bool:
+def supports(
+    h: int, w: int, c: int, k: int, stride: int, itemsize: int = 2
+) -> bool:
     """Stride-1 SAME odd-k depthwise layers whose image fits VMEM.
     Batch-independent: ``_batch_per_block`` degrades to nb=1, so only
     the single-image footprint gates eligibility."""
@@ -89,7 +94,7 @@ def supports(h: int, w: int, c: int, k: int, stride: int) -> bool:
         and k > 1
         and h >= k
         and w >= k
-        and _vmem_bytes(1, h, w, c, k) <= _VMEM_LIMIT
+        and _vmem_bytes(1, h, w, c, k, itemsize) <= _VMEM_LIMIT
     )
 
 
@@ -167,7 +172,7 @@ def _params():
 
 def _run_conv(x, wt, k, flip, interpret):
     b, h, w, c = x.shape
-    nb = _batch_per_block(b, h, w, c, k)
+    nb = _batch_per_block(b, h, w, c, k, x.dtype.itemsize)
     if flip:
         wt = wt[::-1]  # XLA-side: a [k², C] reverse, trivial
     return pl.pallas_call(
@@ -198,7 +203,7 @@ def _depthwise_bwd(interpret, res, dy):
     x, wt = res
     k = int(round(wt.shape[0] ** 0.5))
     b, h, w, c = x.shape
-    nb = _batch_per_block(b, h, w, c, k)
+    nb = _batch_per_block(b, h, w, c, k, x.dtype.itemsize)
     dx = _run_conv(dy, wt, k, True, interpret)
     dw_parts = pl.pallas_call(
         functools.partial(_wgrad_kernel, k=k, nb=nb),
@@ -233,7 +238,7 @@ def depthwise_conv2d(
         raise ValueError(
             f"expected [k, k, 1, C={x.shape[-1]}], got {kernel.shape}"
         )
-    if not supports(x.shape[1], x.shape[2], c, k, 1):
+    if not supports(x.shape[1], x.shape[2], c, k, 1, x.dtype.itemsize):
         raise ValueError(f"unsupported depthwise shape {x.shape} k={k}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

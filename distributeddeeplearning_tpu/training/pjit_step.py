@@ -27,10 +27,11 @@ How sharding flows:
      replicated-or-sharded params come out correctly reduced — the
      explicit ``pmean`` of the shard_map engine is implicit here.
 
-Same loss/metric semantics as the DP engine (one difference: BatchNorm
-under GSPMD computes *global*-batch statistics — sync-BN — whereas the
-shard_map engine keeps the reference's per-replica stats; the pjit path
-targets norm-free/LayerNorm models like ViT where they coincide).
+Same loss/metric semantics as the DP engine, including BatchNorm: the
+train step splits the global batch into one group per data shard
+(``models/norm.py`` ``per_replica_bn``) so BN statistics match the
+shard_map engine's (and the reference's) per-replica semantics exactly;
+``ALLOW_SYNC_BN=1`` opts into global-batch (sync) statistics instead.
 """
 
 from __future__ import annotations
@@ -193,10 +194,18 @@ def make_pjit_train_step(
         rules_table,
     )
 
+    from distributeddeeplearning_tpu.models.norm import per_replica_bn
+    from distributeddeeplearning_tpu.parallel.mesh import dp_size
+
     cfg = config or TrainConfig()
     base_rng = jax.random.PRNGKey(cfg.seed)
     batch_sharding = _mesh_batch_sharding(mesh)
     rules = list(rules_for_mesh(mesh, rules_table(cfg.param_sharding)))
+    # Per-replica BN (SURVEY §7 hard part (b)): split the global batch
+    # into one group per data shard so BatchNorm statistics match the dp
+    # engine's per-replica semantics. ALLOW_SYNC_BN=1 keeps global-batch
+    # (sync) statistics instead.
+    bn_groups = 1 if cfg.allow_sync_bn else dp_size(mesh)
 
     def step(state: TrainState, batch: Batch):
         images, labels = batch
@@ -210,7 +219,7 @@ def make_pjit_train_step(
             # The rules context makes in-model nn.with_logical_constraint
             # calls real (MoE's expert-major activation layout — the
             # all-to-all boundary); without it they are silent no-ops.
-            with mesh, nn.logical_axis_rules(rules):
+            with mesh, nn.logical_axis_rules(rules), per_replica_bn(bn_groups):
                 logits, mutated = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
                     images,
@@ -312,11 +321,14 @@ def build_pjit_state(
     the model-neutral default; "fsdp" — ZeRO-3 over the data axis;
     "dp" — replicated).
 
-    Guards the BN semantics split (SURVEY §7 hard part (b)): this engine
-    normalizes with GLOBAL-batch statistics (sync-BN), the dp engine with
-    the reference's per-replica statistics. A batch_stats-carrying model
-    (ResNet/EfficientNet) is refused unless ``config.allow_sync_bn``
-    (env ``ALLOW_SYNC_BN=1``) opts into the different training semantics.
+    BN semantics (SURVEY §7 hard part (b)): the train step runs
+    batch_stats models with batch-split per-replica statistics
+    (``models/norm.py``) — dp-identical semantics, oracle-tested against
+    the dp engine — unless ``config.allow_sync_bn`` (env
+    ``ALLOW_SYNC_BN=1``) opts into GLOBAL-batch (sync) statistics.
+    The one exception is the fused Pallas bottleneck experiment
+    (``ResNet(fused=True)``): its in-kernel statistics don't group, so
+    it is refused here rather than silently training sync-BN.
     """
     from distributeddeeplearning_tpu.models.sharding import rules_table
 
@@ -329,14 +341,22 @@ def build_pjit_state(
     if not config.allow_sync_bn and jax.tree.leaves(
         abstract.get("batch_stats", {})
     ):
-        raise ValueError(
-            f"model {type(model).__name__!r} carries BatchNorm "
-            "batch_stats: under ENGINE=pjit its statistics would be "
-            "GLOBAL-batch (sync-BN), not the per-replica statistics "
-            "the dp engine (and the reference) uses — training "
-            "semantics and checkpoints would silently differ. Use "
-            "ENGINE=dp, or set ALLOW_SYNC_BN=1 to accept sync-BN."
-        )
+        # Only models whose norm layers are the group-capable subclass
+        # (models/norm.py) get per-replica semantics from the train
+        # step's per_replica_bn context; plain nn.BatchNorm would
+        # silently train sync-BN, so anything not declaring capability
+        # is still refused (the round-2 guard, now narrowed).
+        if not getattr(model, "per_replica_bn_capable", False):
+            raise ValueError(
+                f"model {type(model).__name__!r} carries batch_stats but "
+                "does not declare per_replica_bn_capable: under "
+                "ENGINE=pjit its statistics would be GLOBAL-batch "
+                "(sync-BN), not the per-replica statistics the dp engine "
+                "(and the reference) uses. Build its norm layers with "
+                "models.norm.BatchNorm and set per_replica_bn_capable = "
+                "True, use ENGINE=dp, or set ALLOW_SYNC_BN=1 to accept "
+                "sync-BN."
+            )
 
     return create_sharded_train_state(
         model,

@@ -1,0 +1,98 @@
+"""Unit oracle for batch-split per-replica BatchNorm (models/norm.py).
+
+The engine-level equality test (``tests/test_pjit_step.py``) proves the
+pjit engine matches the dp engine end-to-end; this file pins the module
+itself: G-group statistics must equal running ``nn.BatchNorm``
+separately on each batch split (what each dp replica computes), with
+running stats averaged across splits (what the dp engine's ``pmean``
+stores).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.models.norm import (
+    BatchNorm,
+    active_groups,
+    per_replica_bn,
+)
+
+
+def _init(mod, x):
+    return mod.init(jax.random.PRNGKey(0), x)
+
+
+def test_grouped_equals_per_split_batchnorm():
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 5, 6).astype(np.float32))
+    ours = BatchNorm(use_running_average=False, momentum=0.9)
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9)
+    variables = _init(ref, x)  # identical trees — share them
+
+    with per_replica_bn(2):
+        y, mutated = ours.apply(variables, x, mutable=["batch_stats"])
+
+    y_ref, ref_stats = [], []
+    for half in jnp.split(x, 2, axis=0):
+        yh, mh = ref.apply(variables, half, mutable=["batch_stats"])
+        y_ref.append(yh)
+        ref_stats.append(mh["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(jnp.concatenate(y_ref, 0)), atol=1e-5
+    )
+    # running stats = mean over splits of per-split updates (dp's pmean)
+    for key in ("mean", "var"):
+        want = (ref_stats[0][key] + ref_stats[1][key]) / 2
+        np.testing.assert_allclose(
+            np.asarray(mutated["batch_stats"][key]), np.asarray(want),
+            atol=1e-6,
+        )
+
+
+def test_no_context_is_plain_batchnorm():
+    x = jnp.asarray(np.random.RandomState(1).randn(6, 4).astype(np.float32))
+    ours = BatchNorm(use_running_average=False)
+    ref = nn.BatchNorm(use_running_average=False)
+    variables = _init(ref, x)
+    y, m = ours.apply(variables, x, mutable=["batch_stats"])
+    y_ref, m_ref = ref.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+    for key in ("mean", "var"):
+        np.testing.assert_array_equal(
+            np.asarray(m["batch_stats"][key]),
+            np.asarray(m_ref["batch_stats"][key]),
+        )
+
+
+def test_eval_mode_ignores_grouping():
+    x = jnp.asarray(np.random.RandomState(2).randn(8, 4).astype(np.float32))
+    ours = BatchNorm(use_running_average=True)
+    variables = nn.BatchNorm(use_running_average=True).init(
+        jax.random.PRNGKey(0), x
+    )
+    with per_replica_bn(4):
+        y = ours.apply(variables, x)
+    y_ref = ours.apply(variables, x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+
+
+def test_context_restores_on_exit():
+    assert active_groups() == 1
+    with per_replica_bn(8):
+        assert active_groups() == 8
+    assert active_groups() == 1
+
+
+def test_indivisible_batch_falls_back():
+    """B % G != 0 cannot be grouped — defer to plain BatchNorm rather
+    than crash (the engine only requests G that divides the batch, but
+    the module must stay safe standalone)."""
+    x = jnp.asarray(np.random.RandomState(3).randn(6, 4).astype(np.float32))
+    ours = BatchNorm(use_running_average=False)
+    ref = nn.BatchNorm(use_running_average=False)
+    variables = _init(ref, x)
+    with per_replica_bn(4):
+        y, _ = ours.apply(variables, x, mutable=["batch_stats"])
+    y_ref, _ = ref.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))

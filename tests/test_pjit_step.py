@@ -308,28 +308,128 @@ def test_estimator_frontend_with_pjit_engine(tp_mesh):
     assert np.isfinite(metrics["loss"])
 
 
-def test_bn_models_refused_under_pjit_engine(mesh8):
-    """VERDICT r2 #6: MODEL=resnet50 ENGINE=pjit must not silently train
-    with sync-BN semantics while the dp engine (and the reference) uses
-    per-replica statistics. The engine contract refuses; ALLOW_SYNC_BN=1
-    opts in; the raw library path (create_sharded_train_state) is not
-    guarded."""
-    from distributeddeeplearning_tpu.training.pjit_step import build_pjit_state
+def test_resnet_pjit_matches_dp_engine(mesh8):
+    """VERDICT r3 #4: MODEL=resnet ENGINE=pjit trains with dp-identical
+    per-replica BN semantics — the round-3 refusal guard is replaced by
+    this equality oracle. One full train step of ResNet18 under the pjit
+    engine must match the shard_map dp engine: loss, updated params, and
+    batch_stats (the BN statistics ARE the semantics under test).
+
+    One step, not several: the stem maxpool routes gradients by argmax,
+    so float-noise-level (1e-7) forward differences flip tie decisions
+    and amplify discretely to O(1) param differences within two more
+    steps — measured on both orderings. Multi-step equality is therefore
+    not a meaningful oracle for any BN+maxpool model; the single-step
+    check covers forward, backward, optimizer, and stats updates."""
+    from distributeddeeplearning_tpu.training.pjit_step import (
+        build_pjit_state,
+    )
+    from distributeddeeplearning_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+        replicate_state,
+    )
 
     model = ResNet(depth=18, num_classes=10, dtype=jnp.float32)
     cfg = CFG.replace(engine="pjit", image_size=16)
     tx = optax.sgd(0.05)
-    with pytest.raises(ValueError, match="sync-BN|ALLOW_SYNC_BN"):
-        build_pjit_state(model, cfg, tx, mesh8)
-    # explicit opt-in trains
-    state = build_pjit_state(
-        model, cfg.replace(allow_sync_bn=True), tx, mesh8
+
+    dp_state = replicate_state(
+        create_train_state(model, cfg, tx, input_shape=(1, 16, 16, 3)), mesh8
     )
-    assert state.batch_stats
+    dp_step = make_train_step(model, tx, mesh8, cfg, donate_state=False)
+    pj_state = build_pjit_state(model, cfg, tx, mesh8)
+    pj_step = make_pjit_train_step(model, tx, mesh8, cfg, donate_state=False)
+
+    host = _batch(16, seed=0)
+    dp_state, dp_metrics = dp_step(dp_state, shard_batch(host, mesh8))
+    pj_state, pj_metrics = pj_step(pj_state, shard_batch(host, mesh8))
+
+    np.testing.assert_allclose(
+        float(pj_metrics["loss"]), float(dp_metrics["loss"]), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(pj_state.params)),
+        jax.tree.leaves(jax.device_get(dp_state.params)),
+    ):
+        np.testing.assert_allclose(a, b, atol=2e-5)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(pj_state.batch_stats)),
+        jax.tree.leaves(jax.device_get(dp_state.batch_stats)),
+    ):
+        np.testing.assert_allclose(a, b, atol=2e-5)
+
+    # and further steps train stably through the grouped-BN path
+    for seed in (1, 2):
+        pj_state, pj_metrics = pj_step(
+            pj_state, shard_batch(_batch(16, seed=seed), mesh8)
+        )
+    assert np.isfinite(float(pj_metrics["loss"]))
+
+
+def test_sync_bn_opt_in_differs_from_per_replica(mesh8):
+    """ALLOW_SYNC_BN=1 really changes the statistics: global-batch BN
+    must NOT equal the batch-split per-replica default (on a random
+    batch the per-shard means differ from the global mean)."""
+    from distributeddeeplearning_tpu.training.pjit_step import (
+        build_pjit_state,
+    )
+
+    model = ResNet(depth=18, num_classes=10, dtype=jnp.float32)
+    cfg = CFG.replace(engine="pjit", image_size=16)
+    tx = optax.sgd(0.05)
+    host = _batch(16, seed=3)
+
+    stats = {}
+    for name, sync in (("replica", False), ("sync", True)):
+        c = cfg.replace(allow_sync_bn=sync)
+        state = build_pjit_state(model, c, tx, mesh8)
+        step = make_pjit_train_step(model, tx, mesh8, c, donate_state=False)
+        state, _ = step(state, shard_batch(host, mesh8))
+        stats[name] = jax.device_get(state.batch_stats)
+
+    diffs = [
+        float(np.max(np.abs(a - b)))
+        for a, b in zip(
+            jax.tree.leaves(stats["replica"]), jax.tree.leaves(stats["sync"])
+        )
+    ]
+    assert max(diffs) > 1e-6  # the variance statistics must differ
     # env spelling reaches the flag
     from distributeddeeplearning_tpu.config import TrainConfig
 
     assert TrainConfig.from_env({"ALLOW_SYNC_BN": "1"}).allow_sync_bn
+
+
+def test_incapable_bn_models_still_refused_under_pjit(mesh8):
+    """The narrowed guard: per-replica semantics only exist for models
+    whose norm layers are the group-capable subclass. ResNet(fused=True)
+    (in-kernel statistics) and any plain-``nn.BatchNorm`` model are
+    still refused rather than silently training sync-BN."""
+    import flax.linen as nn
+
+    from distributeddeeplearning_tpu.training.pjit_step import build_pjit_state
+
+    cfg = CFG.replace(engine="pjit", image_size=16)
+    tx = optax.sgd(0.05)
+    fused = ResNet(depth=50, num_classes=10, dtype=jnp.float32, fused=True)
+    with pytest.raises(ValueError, match="per_replica_bn_capable"):
+        build_pjit_state(fused, cfg, tx, mesh8)
+
+    class PlainBNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = nn.Conv(4, (3, 3), dtype=jnp.float32)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(10)(x.mean(axis=(1, 2)))
+
+    with pytest.raises(ValueError, match="per_replica_bn_capable"):
+        build_pjit_state(PlainBNNet(), cfg, tx, mesh8)
+    # sync-BN opt-in still admits both
+    state = build_pjit_state(
+        PlainBNNet(), cfg.replace(allow_sync_bn=True), tx, mesh8
+    )
+    assert state.batch_stats
     # norm-free models are unaffected
     build_pjit_state(
         _vit(), cfg.replace(image_size=CFG.image_size), tx, mesh8

