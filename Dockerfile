@@ -34,14 +34,25 @@ RUN pip install --no-cache-dir \
         'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
     && pip install --no-cache-dir \
         flax optax orbax-checkpoint chex einops \
-        tensorflow-cpu pillow numpy pytest
+        tensorflow-cpu pillow numpy pytest \
+        jupyterlab nbconvert ipykernel scipy
 
 COPY pyproject.toml ./
 COPY distributeddeeplearning_tpu ./distributeddeeplearning_tpu
 COPY examples ./examples
+COPY notebooks ./notebooks
+COPY scripts ./scripts
 COPY tests ./tests
-COPY launch.py bench.py __graft_entry__.py ./
+COPY launch.py bench.py __graft_entry__.py Makefile ./
 RUN pip install --no-cache-dir -e .
+
+# Interactive operator tier (reference Docker/dockerfile:26-61 +
+# jupyter_notebook_config.py: its control-plane image serves the
+# notebooks). Same notebooks, pinned runtime:
+#   docker run -p 8888:8888 <image> \
+#       jupyter lab --ip=0.0.0.0 --port=8888 --allow-root notebooks/
+# and the headless proof is `docker run <image> make notebooks`.
+EXPOSE 8888
 
 # Smoke default: the reference's local container test runs
 # `mpirun -np 2 … FAKE=True` (00_CreateImageAndTest cells 6-7); ours is

@@ -21,14 +21,17 @@ SCRIPT            ?= examples/imagenet_keras_tpu.py
 JOB               ?= ddl-train
 PY                ?= python
 
-.PHONY: build push run smoke test test-fast bench native provision setup \
-        submit stream status stop teardown
+.PHONY: build login push run smoke test test-fast notebooks bench native \
+        provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
 build:
 	docker build -t $(IMAGE):$(TAG) .
 
-push:
+login:	## docker login from .env (DOCKER_USER/DOCKER_PASSWORD, reference cell-11 parity)
+	$(PY) -c "import sys; from distributeddeeplearning_tpu.utils.env import docker_login; sys.exit(docker_login())"
+
+push: login
 	docker push $(IMAGE):$(TAG)
 
 run:	## run the image's default smoke command locally
@@ -47,6 +50,9 @@ test:
 
 test-fast:
 	$(PY) -m pytest tests/ -x -q -k "not two_process"
+
+notebooks:	## execute the notebook tier headlessly; fails on any broken cell
+	$(PY) scripts/run_notebooks.py
 
 bench:
 	$(PY) bench.py

@@ -177,6 +177,18 @@ def _call_worker_decode(args):
     return _WORKER_DECODE(ridx, epoch_index)
 
 
+def make_decode_pool(num_workers: int, decode):
+    """Spawned worker pool with ``decode`` shipped once via initializer
+    (the Keras-reference MULTIPROCESSING workers pattern). Datasets cache
+    one of these across epochs so the spawn cost is paid once."""
+    return concurrent.futures.ProcessPoolExecutor(
+        max(num_workers, 1),
+        mp_context=multiprocessing.get_context("spawn"),
+        initializer=_set_worker_decode,
+        initargs=(decode,),
+    )
+
+
 def _threaded_epoch_batches(
     *,
     n_records: int,
@@ -190,6 +202,7 @@ def _threaded_epoch_batches(
     num_workers: int,
     decode,
     worker_mode: str = "thread",
+    pool=None,
 ):
     """Shared epoch driver for the PIL-decoding datasets (ImageFolder and
     native TFRecord): the same permutation on every process (seeded by
@@ -223,15 +236,12 @@ def _threaded_epoch_batches(
         )
     b = local_batch_size
 
+    owns_pool = pool is None
     if worker_mode == "process":
-        pool_cm = concurrent.futures.ProcessPoolExecutor(
-            max(num_workers, 1),
-            mp_context=multiprocessing.get_context("spawn"),
-            initializer=_set_worker_decode,
-            initargs=(decode,),
-        )
+        if pool is None:
+            pool = make_decode_pool(num_workers, decode)
 
-        def submit(pool, idxs):
+        def submit(idxs):
             # chunk tasks per worker: one IPC round-trip per chunk, not
             # per image (256 messages/step otherwise)
             return pool.map(
@@ -241,16 +251,22 @@ def _threaded_epoch_batches(
             )
 
     else:
-        pool_cm = concurrent.futures.ThreadPoolExecutor(max(num_workers, 1))
+        if pool is None:
+            pool = concurrent.futures.ThreadPoolExecutor(max(num_workers, 1))
 
-        def submit(pool, idxs):
+        def submit(idxs):
             return pool.map(lambda i: decode(int(i), epoch_index), idxs)
 
-    with pool_cm as pool:
+    # try/finally, not a with-block: an abandoned generator (a prefetch
+    # consumer stopping mid-epoch) must shut its workers down at close()
+    # time deterministically — and only when the pool is epoch-local; a
+    # caller-owned pool (dataset cache, reused across epochs to skip the
+    # per-epoch spawn cost) outlives the generator (ADVICE r3).
+    try:
         for step in range(steps_per_epoch):
             if train:
                 idxs = [local[(step * b + j) % len(local)] for j in range(b)]
-                results = list(submit(pool, idxs))
+                results = list(submit(idxs))
                 yield (
                     np.stack([r[0] for r in results]),
                     np.asarray([r[1] for r in results], np.int32),
@@ -263,12 +279,15 @@ def _threaded_epoch_batches(
                 idxs = [
                     local[s] if s < len(local) else 0 for s in slots
                 ]
-                results = list(submit(pool, idxs))
+                results = list(submit(idxs))
                 yield (
                     np.stack([r[0] for r in results]),
                     np.asarray([r[1] for r in results], np.int32),
                     weights,
                 )
+    finally:
+        if owns_pool:
+            pool.shutdown(wait=True)
 
 
 class ImageFolderDataset:
@@ -318,6 +337,39 @@ class ImageFolderDataset:
         # allocation), instead of a serial full-batch astype.
         return img.astype(self.image_dtype, copy=False), label
 
+    def _worker_pool(self):
+        """process mode: ONE spawned pool cached across epochs (spawn
+        startup is paid once, not per epoch — ADVICE r3); thread pools
+        are cheap and stay epoch-local."""
+        if self.worker_mode != "process":
+            return None
+        if getattr(self, "_pool", None) is None:
+            self._pool = make_decode_pool(self.num_workers, self._decode_sample)
+        return self._pool
+
+    def __getstate__(self):
+        # the initializer ships the bound decode method (= this object)
+        # to spawned workers; the executor itself must not ride along
+        state = self.__dict__.copy()
+        state.pop("_pool", None)
+        return state
+
+    def close(self):
+        """Shut the cached worker pool down. Not safe mid-epoch: a live
+        epoch generator holds the pool and would fail on its next batch
+        (it also holds ``self``, so GC/``__del__`` can't fire while one
+        is alive — only an explicit mid-epoch ``close()`` can race)."""
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            self._pool = None
+            pool.shutdown(wait=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def epoch(self, epoch_index: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         yield from _threaded_epoch_batches(
             n_records=len(self.samples),
@@ -331,6 +383,7 @@ class ImageFolderDataset:
             num_workers=self.num_workers,
             decode=self._decode_sample,
             worker_mode=self.worker_mode,
+            pool=self._worker_pool(),
         )
 
     def __iter__(self):
@@ -594,6 +647,31 @@ class NativeTFRecordImageNetDataset:
             arr = _transform_pil(img, self.image_size, self.train, rng)
         return arr.astype(self.image_dtype, copy=False), label
 
+    def _worker_pool(self):
+        """See ``ImageFolderDataset._worker_pool``."""
+        if self.worker_mode != "process":
+            return None
+        if getattr(self, "_pool", None) is None:
+            self._pool = make_decode_pool(self.num_workers, self._decode_record)
+        return self._pool
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_pool", None)
+        return state
+
+    def close(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            self._pool = None
+            pool.shutdown(wait=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def epoch(self, epoch_index: int = 0):
         yield from _threaded_epoch_batches(
             n_records=self.length,
@@ -607,6 +685,7 @@ class NativeTFRecordImageNetDataset:
             num_workers=self.num_workers,
             decode=self._decode_record,
             worker_mode=self.worker_mode,
+            pool=self._worker_pool(),
         )
 
     def __iter__(self):

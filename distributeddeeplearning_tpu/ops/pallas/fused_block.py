@@ -105,7 +105,16 @@ def _run(a, w, affine, *, prologue: str, block_m: int = 512):
     bm = min(block_m, _ceil_to(m, _SUB))
     m_p = _ceil_to(m, bm)
     ap = jnp.pad(a, ((0, m_p - m), (0, 0)))
-    interpret = jax.default_backend() != "tpu"
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    if interpret and backend != "cpu":
+        # Interpreter mode exists for the CPU test mesh only; on GPU it
+        # would run orders of magnitude slower than the unfused XLA path
+        # and silently so (ADVICE r3) — refuse instead.
+        raise NotImplementedError(
+            f"fused bottleneck kernels run compiled on TPU or interpreted "
+            f"on CPU (tests); backend {backend!r} should use fused=False"
+        )
     vma = _vma(a, w, affine)
     y, s, ss = pl.pallas_call(
         functools.partial(_kernel, m_len=m, prologue=prologue),

@@ -161,7 +161,19 @@ def make_pp_train_step(
                 xm, jnp.clip(i, 0, M - 1), 0, keepdims=False
             )
             x_in = jnp.where(s_idx == 0, inject, buf)
-            rngs = {"dropout": jax.random.fold_in(dropout_rng, i)} if train else None
+            # Fold by MICROBATCH index (this stage processes microbatch
+            # i - s_idx at tick i), the same key the 1F1B schedule folds
+            # by — with dropout > 0 the two schedules draw identical
+            # noise and stay loss-equivalent (ADVICE r3).
+            rngs = (
+                {
+                    "dropout": jax.random.fold_in(
+                        dropout_rng, jnp.clip(i - s_idx, 0, M - 1)
+                    )
+                }
+                if train
+                else None
+            )
             y = core.apply({"params": stage_p}, x_in, train=train, rngs=rngs)
             # Last stage finished microbatch i-(S-1) this tick.
             m_idx = i - (S - 1)

@@ -92,6 +92,53 @@ def get_secret(
     return values[key]
 
 
+def docker_login(
+    dotenv_path: Optional[str] = None,
+    registry: Optional[str] = None,
+    runner=None,
+) -> int:
+    """``docker login`` from ``.env`` credentials — the reference wires
+    Dockerhub auth from dotenv into its image push
+    (``00_CreateImageAndTest.ipynb`` cell 11 via ``get_password``,
+    ``common/utils.py:20-25``); this is the same contract for
+    ``make push``: DOCKER_USER + DOCKER_PASSWORD come from (or are
+    captured into) the env file, the password rides stdin so it never
+    appears in argv or shell history. ``registry`` defaults to the
+    ``REGISTRY`` env-file key (Docker Hub when absent). Returns docker's
+    exit code; ``runner`` is injectable for tests.
+
+    Non-interactive shells (CI) with no stored credentials skip the
+    login (returns 0) instead of dying in ``getpass`` — the runner is
+    assumed to have authenticated the daemon out of band
+    (docker/login-action etc.); ``make push`` then proceeds on that
+    ambient auth exactly as it did before this target existed."""
+    import subprocess
+    import sys
+
+    stored = load_env_file(dotenv_for(dotenv_path))
+    if not (
+        stored.get("DOCKER_USER") and stored.get("DOCKER_PASSWORD")
+    ) and not sys.stdin.isatty():
+        print(
+            "docker_login: no .env credentials and no tty — assuming the "
+            "daemon is already authenticated",
+            file=sys.stderr,
+        )
+        return 0
+    user = get_secret(
+        "DOCKER_USER", dotenv_path, prompt="Docker registry user: "
+    )
+    password = get_secret("DOCKER_PASSWORD", dotenv_path)
+    registry = registry or load_env_file(dotenv_for(dotenv_path)).get(
+        "REGISTRY", ""
+    )
+    cmd = ["docker", "login", "--username", user, "--password-stdin"]
+    if registry:
+        cmd.append(registry)
+    run = runner or subprocess.run
+    return run(cmd, input=password.encode()).returncode
+
+
 def write_json_to_file(json_dict: dict, filename: str, mode: str = "w") -> None:
     """Dump a dict as indented JSON (reference ``write_json_to_file``,
     ``common/utils.py:28-31``; used for Batch-AI job JSON — here for

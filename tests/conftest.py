@@ -10,6 +10,11 @@ config (env vars alone are overridden).
 """
 
 import os
+import sys
+
+# tests import repo-root helpers (scripts/…) — pytest only inserts
+# tests/' own dir, so bare `pytest` from elsewhere needs the root added.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _FLAG = "--xla_force_host_platform_device_count=8"
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):

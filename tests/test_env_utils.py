@@ -58,3 +58,50 @@ def test_write_json_to_file(tmp_path):
     out = tmp_path / "job.json"
     write_json_to_file({"b": 1, "a": [1, 2]}, str(out))
     assert json.loads(out.read_text()) == {"a": [1, 2], "b": 1}
+
+
+def test_docker_login_from_env_file(tmp_path):
+    """make push auth (VERDICT r3 #8): credentials come from .env alone —
+    a clean shell with only the env file must produce a docker login
+    call with the password on stdin, never in argv."""
+    from distributeddeeplearning_tpu.utils.env import docker_login, set_key
+
+    path = str(tmp_path / ".env")
+    set_key(path, "DOCKER_USER", "alice")
+    set_key(path, "DOCKER_PASSWORD", "s3cret")
+    calls = {}
+
+    class Result:
+        returncode = 0
+
+    def runner(cmd, input=None):
+        calls["cmd"] = cmd
+        calls["stdin"] = input
+        return Result()
+
+    assert docker_login(path, runner=runner) == 0
+    assert calls["cmd"] == [
+        "docker", "login", "--username", "alice", "--password-stdin"
+    ]
+    assert calls["stdin"] == b"s3cret"
+    assert "s3cret" not in " ".join(calls["cmd"])
+
+    # a REGISTRY key routes the login to that registry
+    set_key(path, "REGISTRY", "gcr.io")
+    docker_login(path, runner=runner)
+    assert calls["cmd"][-1] == "gcr.io"
+
+
+def test_docker_login_noninteractive_without_creds_skips(tmp_path, capsys):
+    """CI contract: an already-authenticated daemon + no .env credentials
+    must not die in getpass — login no-ops so `make push` proceeds."""
+    from distributeddeeplearning_tpu.utils.env import docker_login
+
+    called = {}
+
+    def runner(cmd, input=None):  # pragma: no cover - must not run
+        called["cmd"] = cmd
+
+    # pytest's captured stdin is not a tty
+    assert docker_login(str(tmp_path / ".env"), runner=runner) == 0
+    assert not called

@@ -407,3 +407,40 @@ def test_worker_mode_env_contract():
     # reference Keras spelling (imagenet_keras_horovod.py:44-46)
     assert TrainConfig.from_env({"MULTIPROCESSING": "True"}).worker_mode == "process"
     assert TrainConfig.from_env({"MULTIPROCESSING": "False"}).worker_mode == "thread"
+
+
+def test_process_pool_cached_across_epochs(image_tree):
+    """ADVICE r3: the spawn pool is created once per dataset and reused
+    across epochs (not re-spawned per epoch), and close() shuts it down
+    deterministically."""
+    from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
+
+    ds = ImageFolderDataset(
+        image_tree, worker_mode="process",
+        global_batch_size=4, image_size=16, train=True, num_workers=2,
+    )
+    next(ds.epoch(0))
+    pool0 = ds._pool
+    assert pool0 is not None
+    next(ds.epoch(1))
+    assert ds._pool is pool0  # reused, not respawned
+    ds.close()
+    assert ds._pool is None
+    # usable again after close: a fresh pool is built lazily
+    next(ds.epoch(2))
+    assert ds._pool is not None and ds._pool is not pool0
+    ds.close()
+
+
+def test_abandoned_epoch_local_pool_shuts_down(image_tree):
+    """Thread (epoch-local) pools: abandoning the generator mid-epoch
+    triggers the driver's finally-shutdown at close() time."""
+    from distributeddeeplearning_tpu.data.imagenet import ImageFolderDataset
+
+    ds = ImageFolderDataset(
+        image_tree, global_batch_size=4, image_size=16, train=True,
+        num_workers=2,
+    )
+    gen = ds.epoch(0)
+    next(gen)
+    gen.close()  # GeneratorExit → finally → pool.shutdown

@@ -1,0 +1,60 @@
+"""The notebook tier stays executable (VERDICT r3 #6).
+
+``make notebooks`` (scripts/run_notebooks.py) is the full proof — it
+executes all three and rewrites them with outputs. In the test tier:
+the orchestration notebook executes end-to-end here (its dry-run CLIs
+are fast); the two training notebooks run real multi-minute CPU-mesh
+smokes, so the suite instead pins that their committed copies CARRY
+executed outputs — a stale or never-executed notebook fails.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_provision_notebook_executes_headlessly(tmp_path):
+    import shutil
+
+    from scripts.run_notebooks import run_notebook
+
+    src = os.path.join(REPO, "notebooks", "01_ProvisionAndTrain.ipynb")
+    dst = tmp_path / "01.ipynb"
+    shutil.copy(src, dst)
+    run_notebook(str(dst), timeout=600)  # raises on any cell error
+    nb = json.load(open(dst))
+    codes = [c for c in nb["cells"] if c["cell_type"] == "code"]
+    assert codes and all(c["execution_count"] is not None for c in codes)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["00_BuildImageAndSmoke", "01_ProvisionAndTrain", "02_TrainFrontends"],
+)
+def test_committed_notebooks_carry_outputs(name):
+    """Every committed notebook must be the executed artifact: each code
+    cell has an execution_count and at least one cell produced output
+    (``make notebooks`` regenerates them)."""
+    path = os.path.join(REPO, "notebooks", f"{name}.ipynb")
+    nb = json.load(open(path))
+    codes = [c for c in nb["cells"] if c["cell_type"] == "code"]
+    assert codes, f"{name}: no code cells"
+    missing = [i for i, c in enumerate(codes) if c["execution_count"] is None]
+    assert not missing, (
+        f"{name}: cells {missing} were never executed — run `make notebooks`"
+    )
+    assert any(c["outputs"] for c in codes), f"{name}: no outputs captured"
+
+
+def test_runner_covers_every_notebook():
+    from scripts.run_notebooks import NOTEBOOKS
+
+    on_disk = sorted(
+        os.path.relpath(p, REPO)
+        for p in glob.glob(os.path.join(REPO, "notebooks", "*.ipynb"))
+    )
+    assert on_disk == sorted(NOTEBOOKS)

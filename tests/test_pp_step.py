@@ -29,10 +29,11 @@ CFG = TrainConfig(num_classes=VOCAB, batch_size_per_device=1,
                   weight_decay=0.0, compute_dtype="float32")
 
 
-def _pl(stages=4, layers=4):
+def _pl(stages=4, layers=4, dropout=0.0):
     return PipelineLM(
         variant="tiny", vocab_size=VOCAB, max_seq_len=T,
         num_stages=stages, n_layers=layers, dtype=jnp.float32,
+        dropout=dropout,
     )
 
 
@@ -259,3 +260,26 @@ def test_pp_1f1b_with_weight_decay_and_more_microbatches(pp_mesh):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-5, err_msg=str(pa)
         )
+
+
+def test_pp_schedules_agree_with_dropout(pp_mesh):
+    """ADVICE r3: with dropout > 0 both schedules must draw the SAME
+    noise — GPipe folds the per-device rng by microbatch index exactly
+    like 1F1B — so the two schedules stay loss- and update-equivalent
+    stochastically, not just in expectation."""
+    pl = _pl(dropout=0.3)
+    tx = optax.sgd(0.1)
+    rows = _rows(8, seed=5)
+    state = create_pp_state(pl, CFG, tx, pp_mesh, T)
+    batch = _put_batch(rows, pp_mesh)
+    outs = {}
+    for schedule in ("gpipe", "1f1b"):
+        step = make_pp_train_step(pl, tx, pp_mesh, CFG, num_microbatches=2,
+                                  schedule=schedule, donate_state=False)
+        new_state, metrics = step(state, batch)
+        outs[schedule] = (float(metrics["loss"]),
+                          jax.device_get(new_state.params))
+    np.testing.assert_allclose(outs["gpipe"][0], outs["1f1b"][0], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(outs["gpipe"][1]),
+                    jax.tree.leaves(outs["1f1b"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
