@@ -49,6 +49,14 @@ class TrainConfig:
     num_classes: int = 1000
     image_size: int = 224
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+    # Host→device image staging dtype (env INPUT_STAGING):
+    #   "auto"     — the compute dtype (bf16 halves tunnel/PCIe bytes)
+    #   "uint8"    — raw RGB bytes, normalize ON DEVICE (engines fold
+    #                (x/255 − mean)/sd into the first pass): half of even
+    #                the bf16 transfer — the real-data e2e lever
+    #                (PROFILE.md round-4 decomposition)
+    #   "float32" | "bfloat16" — explicit overrides
+    input_staging: str = "auto"
     # Attention implementation for attention models (ViT):
     # "xla" einsum | "pallas" flash kernel | "ring" sequence-parallel.
     attn_impl: str = "xla"
@@ -253,6 +261,8 @@ class TrainConfig:
             kw["optimizer"] = e["OPTIMIZER"]
         if "LR_SCHEDULE" in e:
             kw["lr_schedule"] = e["LR_SCHEDULE"]
+        if "INPUT_STAGING" in e:
+            kw["input_staging"] = e["INPUT_STAGING"]
         if "GRAD_ACCUM_STEPS" in e:
             kw["grad_accum_steps"] = int(e["GRAD_ACCUM_STEPS"])
         if "WEIGHT_DECAY" in e:

@@ -8,18 +8,36 @@ from distributeddeeplearning_tpu.data.pipeline import shard_batch, prefetch_to_d
 
 
 def staging_dtype(config):
-    """Numpy dtype images are staged in: bf16 when ``config.compute_dtype``
-    is bf16 — halves host→HBM bytes. Numerically identical for any model
-    built from the same config (its first op is that exact cast,
-    post-transfer); if you pair a custom float32 module with this
-    factory, set ``compute_dtype="float32"`` so inputs are not
-    pre-quantized. See PROFILE.md."""
+    """Numpy dtype images are staged in, from ``config.input_staging``:
+
+    * ``"auto"`` — the compute dtype (bf16 halves host→HBM bytes).
+      Numerically identical for any model built from the same config
+      (its first op is that exact cast, post-transfer); if you pair a
+      custom float32 module with this factory, set
+      ``compute_dtype="float32"`` so inputs are not pre-quantized.
+    * ``"uint8"`` — raw RGB bytes: datasets skip host-side
+      normalization and every engine normalizes on device
+      (``data/pipeline.normalize_staged_images``) — half of even the
+      bf16 transfer (PROFILE.md round-4).
+    * explicit ``"float32"`` / ``"bfloat16"``.
+    """
     import numpy as np
 
-    if config.compute_dtype == "bfloat16":
+    choice = getattr(config, "input_staging", "auto")
+    if choice == "uint8":
+        return np.dtype(np.uint8)
+    if choice == "float32":
+        return np.dtype(np.float32)
+    if choice == "bfloat16" or (
+        choice == "auto" and config.compute_dtype == "bfloat16"
+    ):
         import ml_dtypes
 
         return np.dtype(ml_dtypes.bfloat16)
+    if choice != "auto":
+        raise ValueError(
+            f"input_staging must be auto|uint8|float32|bfloat16, got {choice!r}"
+        )
     return np.dtype(np.float32)
 
 

@@ -109,9 +109,15 @@ def _center_crop_resize(img, size: int):
     return img.resize((size, size), Image.BILINEAR, box=(x, y, x + crop, y + crop))
 
 
-def _transform_pil(img, size: int, train: bool, rng: np.random.Generator) -> np.ndarray:
-    """Augment/normalize an open PIL image (shared by the path-based and
-    TFRecord-payload decoders)."""
+def _transform_pil(
+    img, size: int, train: bool, rng: np.random.Generator,
+    normalize: bool = True,
+) -> np.ndarray:
+    """Augment (and, unless staging raw uint8 bytes, normalize) an open
+    PIL image — shared by the path-based and TFRecord-payload decoders.
+    ``normalize=False`` returns the augmented uint8 pixels untouched;
+    the engines then fold (x/255 − mean)/sd into the first device pass
+    (``data/pipeline.normalize_staged_images``)."""
     from PIL import Image
 
     img = img.convert("RGB")
@@ -121,17 +127,20 @@ def _transform_pil(img, size: int, train: bool, rng: np.random.Generator) -> np.
             img = img.transpose(Image.FLIP_LEFT_RIGHT)
     else:
         img = _center_crop_resize(img, size)
+    if not normalize:
+        return np.asarray(img, np.uint8)
     arr = np.asarray(img, np.float32) / 255.0
     return (arr - _MEAN) / _SD
 
 
 def _load_image(
-    path: str, size: int, train: bool, rng: np.random.Generator
+    path: str, size: int, train: bool, rng: np.random.Generator,
+    normalize: bool = True,
 ) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as img:
-        return _transform_pil(img, size, train, rng)
+        return _transform_pil(img, size, train, rng, normalize=normalize)
 
 
 def _check_batch_divisible(global_batch_size: int, process_count: int) -> None:
@@ -331,7 +340,10 @@ class ImageFolderDataset:
         rng = np.random.default_rng(
             (self.seed, epoch_index, int(sample_idx), self.process_index)
         )
-        img = _load_image(path, self.image_size, self.train, rng)
+        img = _load_image(
+            path, self.image_size, self.train, rng,
+            normalize=self.image_dtype != np.uint8,
+        )
         # Cast per-image inside the pool: stack() in the driver then
         # builds the batch directly at the staging dtype (bf16 = half the
         # allocation), instead of a serial full-batch astype.
@@ -483,10 +495,18 @@ class TFRecordImageNetDataset:
                 tf.cast(image, tf.float32), _EVAL_CENTER_FRACTION
             )
             image = tf.image.resize(image, (size, size))
-        image = tf.cast(image, tf.float32) / 255.0
-        image = (image - _MEAN) / _SD
-        # Stage at the model's compute dtype (bf16 halves host→HBM bytes).
-        image = tf.cast(image, self._tf_image_dtype)
+        if self._tf_image_dtype == tf.uint8:
+            # raw-byte staging: normalize happens on device
+            # (data/pipeline.normalize_staged_images)
+            image = tf.cast(
+                tf.clip_by_value(tf.round(image), 0.0, 255.0), tf.uint8
+            )
+        else:
+            image = tf.cast(image, tf.float32) / 255.0
+            image = (image - _MEAN) / _SD
+            # Stage at the model's compute dtype (bf16 halves host→HBM
+            # bytes).
+            image = tf.cast(image, self._tf_image_dtype)
         label = tf.cast(feats["image/class/label"], tf.int32)
         return image, label
 
@@ -644,7 +664,10 @@ class NativeTFRecordImageNetDataset:
             (self.seed, epoch_index, int(ridx), self.process_index)
         )
         with Image.open(io.BytesIO(encoded)) as img:
-            arr = _transform_pil(img, self.image_size, self.train, rng)
+            arr = _transform_pil(
+                img, self.image_size, self.train, rng,
+                normalize=self.image_dtype != np.uint8,
+            )
         return arr.astype(self.image_dtype, copy=False), label
 
     def _worker_pool(self):

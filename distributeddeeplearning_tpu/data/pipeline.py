@@ -119,3 +119,32 @@ def prefetch_to_device(
         # unblock and terminate the producer so staged device batches and
         # the thread are released rather than pinned for the process life.
         cancelled.set()
+
+
+def normalize_staged_images(images):
+    """Fold the host pipeline's normalization into the device program for
+    raw-byte staging (``INPUT_STAGING=uint8``): uint8 inputs become
+    torchvision-normalized f32 — XLA fuses the (x/255 − mean)/sd chain
+    into the first pass that reads the batch, so the only cost of uint8
+    staging is LESS transfer (half of bf16, a quarter of f32).
+
+    Contract: a uint8 NHWC batch entering a vision engine means
+    "un-normalized RGB bytes" (every dataset honors this —
+    ``data/__init__.staging_dtype``). Anything else passes through
+    untouched — other dtypes are already normalized host-side, and the
+    rank-4 gate keeps uint8 TOKEN batches (rank 2 — byte-level LMs feed
+    ``nn.Embed`` integer codes through these same engines) out of the
+    image path.
+    """
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.config import (
+        IMAGENET_RGB_MEAN,
+        IMAGENET_RGB_SD,
+    )
+
+    if images.dtype != jnp.uint8 or images.ndim != 4:
+        return images
+    mean = jnp.asarray(IMAGENET_RGB_MEAN, jnp.float32)
+    sd = jnp.asarray(IMAGENET_RGB_SD, jnp.float32)
+    return (images.astype(jnp.float32) / 255.0 - mean) / sd

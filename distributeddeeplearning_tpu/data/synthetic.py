@@ -99,11 +99,20 @@ class SyntheticImageDataset:
         # single-threaded. Deterministic in `seed` alone, like before.
         from distributeddeeplearning_tpu.native import fill_uniform
 
-        self._images = (
-            fill_uniform(
-                (pool_n, image_size, image_size, channels), seed=seed
-            ) * np.float32(2.0) - np.float32(1.0)
-        ).astype(dtype, copy=False)
+        if np.dtype(dtype) == np.uint8:
+            # raw-byte staging (INPUT_STAGING=uint8): synthetic pixels in
+            # the real datasets' pre-normalization range
+            self._images = (
+                fill_uniform(
+                    (pool_n, image_size, image_size, channels), seed=seed
+                ) * np.float32(255.0)
+            ).astype(np.uint8)
+        else:
+            self._images = (
+                fill_uniform(
+                    (pool_n, image_size, image_size, channels), seed=seed
+                ) * np.float32(2.0) - np.float32(1.0)
+            ).astype(dtype, copy=False)
         self._labels = rng.randint(0, num_classes, size=(pool_n,)).astype(np.int32)
         # Virtual→physical translation index (reference data_generator.py:45).
         # Sized to the *local* share of the virtual length; offset by process

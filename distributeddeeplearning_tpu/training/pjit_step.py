@@ -208,11 +208,16 @@ def make_pjit_train_step(
     bn_groups = 1 if cfg.allow_sync_bn else dp_size(mesh)
 
     def step(state: TrainState, batch: Batch):
+        from distributeddeeplearning_tpu.data.pipeline import (
+            normalize_staged_images,
+        )
+
         images, labels = batch
         # Bind the step to ITS mesh: a batch committed to a different
         # mesh/layout errors here instead of silently resharding.
         images = lax.with_sharding_constraint(images, batch_sharding)
         labels = lax.with_sharding_constraint(labels, batch_sharding)
+        images = normalize_staged_images(images)  # uint8 staging
         dropout_rng = jax.random.fold_in(base_rng, state.step)
 
         def loss_fn(params):
@@ -277,10 +282,15 @@ def make_pjit_eval_step(
     rules = list(rules_for_mesh(mesh, rules_table(cfg.param_sharding)))
 
     def eval_step(state: TrainState, batch):
+        from distributeddeeplearning_tpu.data.pipeline import (
+            normalize_staged_images,
+        )
+
         images, labels, weights = batch
         images = lax.with_sharding_constraint(images, batch_sharding)
         labels = lax.with_sharding_constraint(labels, batch_sharding)
         weights = lax.with_sharding_constraint(weights, batch_sharding)
+        images = normalize_staged_images(images)  # uint8 staging
         with mesh, nn.logical_axis_rules(rules):
             logits = model.apply(
                 {"params": state.params, "batch_stats": state.batch_stats},

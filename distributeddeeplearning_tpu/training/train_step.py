@@ -252,6 +252,12 @@ def make_train_step(
 
     def local_step(state: TrainState, batch: Batch):
         images, labels = batch
+        # uint8 staging: normalization folds into the first device pass
+        from distributeddeeplearning_tpu.data.pipeline import (
+            normalize_staged_images,
+        )
+
+        images = normalize_staged_images(images)
         # Per-step, per-device dropout key: stochastic models (EfficientNet
         # drop-path/dropout, ViT with dropout>0) draw independent noise on
         # every device and every step, like the reference's per-worker
@@ -381,6 +387,11 @@ def make_eval_step(
 
     def local_eval(state: TrainState, batch):
         images, labels, weights = batch
+        from distributeddeeplearning_tpu.data.pipeline import (
+            normalize_staged_images,
+        )
+
+        images = normalize_staged_images(images)
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images,

@@ -27,7 +27,11 @@ import time
 
 import numpy as np
 
-DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".benchdata")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python scripts/real_data_bench.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+DEFAULT_ROOT = os.path.join(_REPO, ".benchdata")
 
 
 def prepare(root: str, n_images: int, image_size: int = 224, classes: int = 8):
@@ -136,8 +140,59 @@ def host(root: str, steps: int, batch: int, workers: int, worker_mode: str):
     return results
 
 
+def transfer(batch: int, image_size: int = 224, reps: int = 12):
+    """Host→device transfer rate in isolation, per staging dtype — the
+    middle leg of the e2e decomposition (host decode → transfer → step).
+    Measures a sharded ``device_put`` of one global batch, fenced by a
+    device readback (block_until_ready alone does not fence through the
+    axon relay — see bench.py)."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+
+    mesh = data_parallel_mesh(jax.device_count())
+    rng = np.random.RandomState(0)
+    base = rng.randint(0, 255, size=(batch, image_size, image_size, 3))
+    touch = jax.jit(lambda x: jnp.sum(x[:, 0, 0, 0].astype(jnp.float32)))
+    out = {}
+    for name, arr in (
+        ("float32", base.astype(np.float32)),
+        ("bfloat16", base.astype(ml_dtypes.bfloat16)),
+        ("uint8", base.astype(np.uint8)),
+    ):
+        labels = rng.randint(0, 1000, size=(batch,)).astype(np.int32)
+        x, _ = shard_batch((arr, labels), mesh)
+        float(touch(x))  # warm compile
+        # (a) fenced: one put at a time — the latency-bound floor
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            x, _ = shard_batch((arr, labels), mesh)
+            float(touch(x))
+        fenced = (time.perf_counter() - t0) / reps
+        # (b) streamed: enqueue every put, fence once — what the
+        # prefetch pipeline actually achieves with transfers in flight
+        t0 = time.perf_counter()
+        xs = [shard_batch((arr, labels), mesh)[0] for _ in range(reps)]
+        for x in xs:
+            float(touch(x))
+        streamed = (time.perf_counter() - t0) / reps
+        mb = arr.nbytes / 1e6
+        out[name] = batch / streamed
+        print(
+            f"transfer {name:8s}: {mb:6.1f} MB/batch  "
+            f"fenced {fenced * 1e3:7.1f} ms ({batch / fenced:7.1f} img/s)  "
+            f"streamed {streamed * 1e3:7.1f} ms "
+            f"({mb / streamed / 1e3:5.2f} GB/s, {batch / streamed:7.1f} img/s)"
+        )
+    return out
+
+
 def e2e(root: str, batch: int, steps: int):
-    """Real pipeline → prefetch → compiled DP train step on the device."""
+    """Real pipeline → prefetch → compiled DP train step on the device.
+    ``INPUT_STAGING=uint8`` stages raw bytes + on-device normalize."""
     import jax
     import jax.numpy as jnp
 
@@ -158,6 +213,7 @@ def e2e(root: str, batch: int, steps: int):
         data_dir=os.path.join(root, "imagefolder"),
         batch_size_per_device=batch,
         num_workers=int(os.environ.get("NUM_WORKERS", "8")),
+        input_staging=os.environ.get("INPUT_STAGING", "auto"),
     )
     data = make_dataset(cfg, train=True)
     model = ResNet(depth=50, num_classes=1000, dtype=jnp.bfloat16)
@@ -197,7 +253,7 @@ def e2e(root: str, batch: int, steps: int):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("mode", choices=["prepare", "host", "e2e"])
+    ap.add_argument("mode", choices=["prepare", "host", "transfer", "e2e"])
     ap.add_argument("--root", default=DEFAULT_ROOT)
     ap.add_argument("--images", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=8)
@@ -210,6 +266,8 @@ def main():
         prepare(args.root, args.images)
     elif args.mode == "host":
         host(args.root, args.steps, args.batch, args.workers, args.worker_mode)
+    elif args.mode == "transfer":
+        transfer(args.batch)
     else:
         e2e(args.root, args.batch, args.steps)
 
