@@ -73,28 +73,36 @@ def heads_per_block(head_dim: int) -> int:
     return max(1, _LANES // head_dim)
 
 
-def _bwd_vmem_bytes(nb: int, tp: int, width: int = _LANES) -> int:
+def _bwd_vmem_bytes(
+    nb: int, tp: int, width: int = _LANES, itemsize: int = 2
+) -> int:
     """Backward-pass scoped-VMEM estimate (the fwd needs strictly less):
-    5 double-buffered bf16 input blocks + the double-buffered output +
-    3 f32 scratch blocks + ~6 live [T, T] f32 score intermediates, with
-    30 % slack for Mosaic temporaries. ``width`` is the block lane width
-    hp·d (= 128 for d ≤ 128; = d for wider heads). Calibration: the
-    nb=16, Tp=208, width=128 configuration this formula puts at 16.4 MB
-    pre-slack was measured by Mosaic at 16.2 MB (over the limit); nb=8
-    (8.7 MB pre-slack) fits."""
+    5 double-buffered input blocks + the double-buffered output +
+    3 scratch blocks (all at the activation ``itemsize`` — scratch
+    follows ``qkv.dtype``) + ~6 live [T, T] f32 score intermediates,
+    with 30 % slack for Mosaic temporaries. ``width`` is the block lane
+    width hp·d (= 128 for d ≤ 128; = d for wider heads). Calibration
+    (bf16, f32 scratch as originally shipped): nb=16 at Tp=208/width=128
+    computed 16.4 MB pre-slack and Mosaic measured 16.2 MB (over the
+    limit); nb=8 fits. bf16 scratch measured perf-neutral with identical
+    final precision (one f32→bf16 rounding either way)."""
     rows = nb * tp * width
-    blocks = 5 * 2 * rows * 2 + 2 * rows * 2 + 3 * rows * 4
+    blocks = (5 * 2 + 2 + 3) * rows * itemsize
     scores = 6 * tp * tp * 4
     return int((blocks + scores) * 1.3)
 
 
-def _batch_per_block(batch: int, seq_len: int, width: int = _LANES) -> int:
+def _batch_per_block(
+    batch: int, seq_len: int, width: int = _LANES, itemsize: int = 2
+) -> int:
     """Samples per program: enough to amortise per-program dispatch/DMA
     overhead (1 sample/program measured ~12 µs-dominated), small enough
     that the backward stays under the scoped-VMEM limit."""
     tp = _ceil_to(seq_len, 16)
     for nb in (8, 4, 2, 1):
-        if batch % nb == 0 and _bwd_vmem_bytes(nb, tp, width) <= _VMEM_BUDGET:
+        if batch % nb == 0 and (
+            _bwd_vmem_bytes(nb, tp, width, itemsize) <= _VMEM_BUDGET
+        ):
             return nb
     return 1
 
@@ -201,9 +209,9 @@ def _bwd_kernel(
                 dks.append(_head_dot(ds, q, ((0,), (0,))))
                 dvs.append(_head_dot(pn.astype(do.dtype), do, ((0,), (0,))))
             cat = lambda xs: xs[0] if hp == 1 else jnp.concatenate(xs, axis=1)
-            dq_scr[n] = cat(dqs)
-            dk_scr[n] = cat(dks)
-            dv_scr[n] = cat(dvs)
+            dq_scr[n] = cat(dqs).astype(dq_scr.dtype)
+            dk_scr[n] = cat(dks).astype(dk_scr.dtype)
+            dv_scr[n] = cat(dvs).astype(dv_scr.dtype)
 
     for i, scr in enumerate((dq_scr, dk_scr, dv_scr)):
         @pl.when(part == i)
@@ -234,7 +242,8 @@ def _geometry(qkv, heads):
     d = hd // heads
     hp = heads_per_block(d)
     w = hp * d
-    return b, t, hd, d, hp, w, heads // hp, _batch_per_block(b, t, w)
+    nb = _batch_per_block(b, t, w, qkv.dtype.itemsize)
+    return b, t, hd, d, hp, w, heads // hp, nb
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
@@ -283,8 +292,11 @@ def _packed_bwd_rule(heads, causal, scale, interpret, res, do):
             (nb, tp, w), lambda b, g, part, G=groups: (b, 0, part * G + g)
         ),
         out_shape=jax.ShapeDtypeStruct((b, t, 3 * hd), qkv.dtype, vma=vma),
+        # Scratch at the INPUT dtype: for bf16 activations the eventual
+        # output rounds f32→bf16 exactly once either way (perf-neutral,
+        # half the scratch VMEM — measured); f32 inputs keep f32 grads.
         scratch_shapes=[
-            pltpu.VMEM((nb, tp, w), jnp.float32) for _ in range(3)
+            pltpu.VMEM((nb, tp, w), qkv.dtype) for _ in range(3)
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")

@@ -1,0 +1,132 @@
+"""MoE efficiency audit (VERDICT r3 #7): where lm_moe's ~25 % vs dense goes.
+
+Measures, on the attached chip, tokens/sec + ``cost_analysis`` bytes and
+FLOPs per step for dense ``lm_small`` vs ``lm_moe_small`` across the
+routing design space — top-1 vs top-2, capacity factor sweep — and
+prints the per-component byte account of the routing machinery (the
+dispatch/combine one-hot tensors and the expert-major activation
+buffers are the structural overhead: they exist in the MoE step and not
+the dense one).
+
+Usage: python scripts/moe_audit.py [--seq-len 1024] [--batch 8]
+One table row per variant; PROFILE.md's MoE section records the result.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def measure(model_name, seq_len, batch, steps=20, **model_kw):
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+    from distributeddeeplearning_tpu.training import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+    from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+    vocab = 32_000
+    cfg = TrainConfig(
+        model=model_name, batch_size_per_device=batch, num_classes=vocab,
+        attn_impl="pallas" if jax.default_backend() == "tpu" else "xla",
+    )
+    model = get_model(
+        model_name, num_classes=vocab, max_seq_len=seq_len,
+        attn_impl=cfg.attn_impl, **model_kw,
+    )
+    mesh = data_parallel_mesh(jax.device_count())
+    tx, _ = create_optimizer(cfg, steps_per_epoch=64)
+    state = replicate_state(
+        create_train_state(
+            model, cfg, tx, input_shape=(1, seq_len), input_dtype=jnp.int32
+        ),
+        mesh,
+    )
+    step = make_train_step(model, tx, mesh, cfg, donate_state=False)
+    rng = np.random.RandomState(42)
+    rows = rng.randint(0, vocab, size=(batch, seq_len + 1)).astype(np.int32)
+    b = shard_batch((rows[:, :-1], rows[:, 1:]), mesh)
+
+    compiled = step.lower(state, b).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, list) else cost
+    gb = cost.get("bytes accessed", float("nan")) / 1e9
+    tf = cost.get("flops", float("nan")) / 1e12
+    for _ in range(3):
+        state, metrics = compiled(state, b)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled(state, b)
+    float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    return {
+        "tok_s": batch * seq_len / dt,
+        "ms": dt * 1e3,
+        "gb": gb,
+        "tflops": tf,
+    }
+
+
+def routing_bytes(batch, seq_len, experts, top_k, cf, hidden=512):
+    """Analytic bytes of the routing machinery itself (f32 dispatch +
+    combine [b,s,e,c] plus bf16 expert-major in/out [e,b,c,d]), one
+    write + one read each, fwd + symmetric bwd (×2)."""
+    c = int(np.ceil(top_k * seq_len / experts * cf))
+    onehot = batch * seq_len * experts * c * 4 * 2  # dispatch + combine
+    expert_io = experts * batch * c * hidden * 2 * 2  # in + out, bf16
+    return 2 * 2 * (onehot + expert_io), c  # r+w, fwd+bwd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    t, b = args.seq_len, args.batch
+
+    dense = measure("lm_small", t, b)
+    print(
+        f"{'variant':28s} {'tok/s':>9s} {'ms':>7s} {'GB':>7s} {'TF':>6s} "
+        f"{'vs dense':>8s} {'cap':>4s}"
+    )
+    print(
+        f"{'lm_small (dense)':28s} {dense['tok_s']:9.0f} {dense['ms']:7.1f} "
+        f"{dense['gb']:7.2f} {dense['tflops']:6.2f} {'1.000':>8s} {'-':>4s}"
+    )
+    for label, kw in (
+        ("moe top2 cf1.25 (default)", dict(moe_top_k=2, moe_capacity_factor=1.25)),
+        ("moe top1 cf1.25", dict(moe_top_k=1, moe_capacity_factor=1.25)),
+        ("moe top2 cf1.0", dict(moe_top_k=2, moe_capacity_factor=1.0)),
+        ("moe top2 cf2.0", dict(moe_top_k=2, moe_capacity_factor=2.0)),
+        ("moe top1 cf2.0", dict(moe_top_k=1, moe_capacity_factor=2.0)),
+    ):
+        r = measure("lm_moe_small", t, b, **kw)
+        route_gb, cap = routing_bytes(
+            b, t, 8, kw["moe_top_k"], kw["moe_capacity_factor"]
+        )
+        print(
+            f"{label:28s} {r['tok_s']:9.0f} {r['ms']:7.1f} {r['gb']:7.2f} "
+            f"{r['tflops']:6.2f} {r['tok_s'] / dense['tok_s']:8.3f} {cap:4d}"
+            f"   (routing-machinery est {route_gb / 1e9:.2f} GB)"
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
