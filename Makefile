@@ -21,8 +21,8 @@ SCRIPT            ?= examples/imagenet_keras_tpu.py
 JOB               ?= ddl-train
 PY                ?= python
 
-.PHONY: build login push run smoke test test-fast notebooks bench native \
-        provision setup submit stream status stop teardown
+.PHONY: build login push run jupyter smoke test test-fast notebooks bench \
+        native provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
 build:
@@ -36,6 +36,15 @@ push: login
 
 run:	## run the image's default smoke command locally
 	docker run --rm -it $(IMAGE):$(TAG)
+
+# Reference Makefile:22-29 parity: its `jupyter` target mounts PWD + data
+# into the operator container and serves the notebooks.
+jupyter:	## serve the notebook tier from the image
+	docker run --rm -it -p 8888:8888 \
+	    -v $(CURDIR):/workspace -v $(or $(DATA),/tmp/data):/data \
+	    -e DOCKER_REPOSITORY=$(DOCKER_REPOSITORY) \
+	    $(IMAGE):$(TAG) \
+	    jupyter lab --ip=0.0.0.0 --port=8888 --allow-root --no-browser notebooks/
 
 ## Local verification (reference's mpirun -np 2 smoke, no docker needed)
 smoke:
