@@ -263,6 +263,8 @@ class TrainConfig:
             kw["lr_schedule"] = e["LR_SCHEDULE"]
         if "INPUT_STAGING" in e:
             kw["input_staging"] = e["INPUT_STAGING"]
+        if "PREFETCH_BATCHES" in e:
+            kw["prefetch_batches"] = int(e["PREFETCH_BATCHES"])
         if "GRAD_ACCUM_STEPS" in e:
             kw["grad_accum_steps"] = int(e["GRAD_ACCUM_STEPS"])
         if "WEIGHT_DECAY" in e:

@@ -49,6 +49,11 @@ def test_env_contract():
     assert c.data_dir == "/data/train"
     assert c.val_data_dir == "/data/val"
     assert c.model_dir == "/out"
+    # pipeline knobs (round 4)
+    c2 = TrainConfig.from_env(
+        {"INPUT_STAGING": "uint8", "PREFETCH_BATCHES": "4"}
+    )
+    assert c2.input_staging == "uint8" and c2.prefetch_batches == 4
 
 
 def test_overrides_beat_env():

@@ -434,3 +434,25 @@ def test_incapable_bn_models_still_refused_under_pjit(mesh8):
     build_pjit_state(
         _vit(), cfg.replace(image_size=CFG.image_size), tx, mesh8
     )
+
+
+def test_uint8_staging_through_pjit_engine(mesh8):
+    """INPUT_STAGING=uint8 composes with ENGINE=pjit: the GSPMD train
+    and eval steps fold the normalize in, same as the dp engine."""
+    from distributeddeeplearning_tpu.training.pjit_step import build_pjit_state
+
+    model = ResNet(depth=18, num_classes=10, dtype=jnp.float32)
+    cfg = CFG.replace(engine="pjit", image_size=16)
+    tx = optax.sgd(0.05)
+    state = build_pjit_state(model, cfg, tx, mesh8)
+    step = make_pjit_train_step(model, tx, mesh8, cfg, donate_state=False)
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 255, size=(16, 16, 16, 3)).astype(np.uint8)
+    labels = rng.randint(0, 10, size=(16,)).astype(np.int32)
+    state, metrics = step(state, shard_batch((raw, labels), mesh8))
+    assert np.isfinite(float(metrics["loss"]))
+    ev = make_pjit_eval_step(model, mesh8, cfg)
+    out = ev(state, shard_batch(
+        (raw, labels, np.ones(16, np.float32)), mesh8
+    ))
+    assert np.isfinite(float(out["loss"])) and float(out["count"]) == 16.0
