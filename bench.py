@@ -292,9 +292,69 @@ def lm_main():
     return 1
 
 
+def _intended_metric():
+    """(metric, unit) the active env selects — resolvable BEFORE any jax
+    call, so failure records stay attributable to the protocol that was
+    asked for (the same naming logic the mode mains use)."""
+    import os
+
+    model = os.environ.get("BENCH_MODEL", "")
+    if os.environ.get("BENCH_DECODE", "") == "1":
+        return f"{model or 'lm_small'}_decode_tokens_per_sec", "tokens/sec"
+    if model.startswith("lm_"):
+        return f"{model}_synthetic_train_tokens_per_sec", "tokens/sec"
+    depth = int(os.environ.get("BENCH_DEPTH", "50"))
+    size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    vision_model = model if model and model != "resnet50" else None
+    if depth == 50 and size == 224 and not vision_model:
+        return "resnet50_synthetic_train_images_per_sec", "images/sec"
+    if vision_model:
+        return f"{vision_model}_{size}px_images_per_sec", "images/sec"
+    return f"resnet{depth}_{size}px_smoke_images_per_sec", "images/sec"
+
+
+def _guard_device_init(timeout_s: float = 300.0) -> None:
+    """Fail FAST (one structured JSON line) if backend init hangs.
+
+    A dead TPU relay makes ``jax.devices()`` block forever rather than
+    error (observed end of round 4: the axon tunnel went down and every
+    jax call hung). Normal init is seconds; five minutes without devices
+    means the attachment is gone — emit the active protocol's failure
+    record instead of hanging the driver."""
+    import os
+    import threading
+
+    done = threading.Event()
+    metric, unit = _intended_metric()
+
+    def watchdog():
+        if not done.wait(timeout_s):
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": 0.0,
+                        "unit": unit,
+                        "vs_baseline": 0.0,
+                        "error": (
+                            f"device init did not complete in {timeout_s:.0f}s"
+                            " — accelerator attachment/relay down?"
+                        ),
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(1)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    jax.device_count()  # first backend touch — the call that hangs
+    done.set()
+
+
 def main():
     import os
 
+    _guard_device_init()
     if os.environ.get("BENCH_DECODE", "") == "1":
         return decode_main()
     if os.environ.get("BENCH_MODEL", "").startswith("lm_"):

@@ -59,3 +59,43 @@ def test_bench_decode_mode(mesh8, capsys, monkeypatch):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["metric"] == "lm_tiny_decode_tokens_per_sec"
     assert out["value"] > 0
+
+
+def test_device_init_watchdog():
+    """A dead accelerator relay makes jax.devices() hang forever
+    (observed: the tunnel went down and every jax call blocked). The
+    bench must fail FAST with a structured record naming the protocol
+    that was asked for, not hang the driver. Subprocess child (fresh
+    interpreter — fork-after-threads from a JAX-initialized pytest
+    process can deadlock on inherited locks)."""
+    import json
+    import subprocess
+    import sys
+
+    import bench
+
+    # normal path: no-op
+    bench._guard_device_init(timeout_s=60.0)
+    # env resolves the failure record's metric before any jax call
+    assert bench._intended_metric()[0].startswith("resnet50_synthetic")
+
+    child = (
+        "import time, unittest.mock as mock\n"
+        "import bench\n"
+        "with mock.patch.object(bench.jax, 'device_count',"
+        " side_effect=lambda: time.sleep(30)):\n"
+        "    bench._guard_device_init(timeout_s=1.0)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "BENCH_MODEL": "lm_small"},
+        cwd=__import__("os").path.dirname(
+            __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+        ),
+    )
+    assert r.returncode == 1
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0.0 and "device init" in rec["error"]
+    assert rec["metric"] == "lm_small_synthetic_train_tokens_per_sec"
+    assert rec["unit"] == "tokens/sec"
