@@ -37,6 +37,9 @@ the same code path the dp engine runs per shard.
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import inspect
+import warnings
 
 import flax.linen as nn
 import jax
@@ -44,24 +47,56 @@ import jax.numpy as jnp
 from flax.linen import module as flax_module
 from flax.linen import normalization as flax_norm
 
-_GROUPS = 1
+# ContextVar, not a module global: the group count is trace-local state,
+# and concurrent traces (train + eval compiled from different threads)
+# must each observe their own context (ADVICE r4).
+_GROUPS: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "per_replica_bn_groups", default=1
+)
+
+
+def _check_flax_private_api() -> None:
+    """The grouped path reuses flax's private ``_compute_stats`` /
+    ``_normalize`` so per-group math is bit-identical to what
+    ``nn.BatchNorm`` runs per shard under the dp engine. Private API can
+    drift between flax minors — verify the parameter names we pass (all
+    passed by keyword below) at import so a signature break fails HERE
+    with a actionable message, not mid-trace (ADVICE r4)."""
+    need_stats = {"x", "axes", "dtype", "use_fast_variance",
+                  "force_float32_reductions"}
+    need_norm = {"mdl", "x", "mean", "var", "reduction_axes", "feature_axes",
+                 "dtype", "param_dtype", "epsilon", "use_bias", "use_scale",
+                 "bias_init", "scale_init", "force_float32_reductions"}
+    have_stats = set(inspect.signature(flax_norm._compute_stats).parameters)
+    have_norm = set(inspect.signature(flax_norm._normalize).parameters)
+    missing = (need_stats - have_stats) | (need_norm - have_norm)
+    if missing:
+        import flax
+
+        raise ImportError(
+            f"flax {flax.__version__} changed the private normalization API "
+            f"this module's grouped-BN path relies on (missing params: "
+            f"{sorted(missing)}). Re-check models/norm.py against "
+            "flax.linen.normalization."
+        )
+
+
+_check_flax_private_api()
 
 
 @contextlib.contextmanager
 def per_replica_bn(groups: int):
     """Trace-time context: BatchNorm computes statistics per batch-split
     group (one group per data shard). ``groups=1`` is a no-op."""
-    global _GROUPS
-    prev = _GROUPS
-    _GROUPS = int(groups)
+    token = _GROUPS.set(int(groups))
     try:
         yield
     finally:
-        _GROUPS = prev
+        _GROUPS.reset(token)
 
 
 def active_groups() -> int:
-    return _GROUPS
+    return _GROUPS.get()
 
 
 class BatchNorm(nn.BatchNorm):
@@ -75,12 +110,10 @@ class BatchNorm(nn.BatchNorm):
         use_ra = flax_module.merge_param(
             "use_running_average", self.use_running_average, use_running_average
         )
-        groups = _GROUPS
-        if (
-            groups <= 1
-            or use_ra
-            or self.is_initializing()
-            or mask is not None
+        groups = _GROUPS.get()
+        expected_fallback = groups <= 1 or use_ra or self.is_initializing()
+        if expected_fallback or (
+            mask is not None
             or self.axis != -1
             # explicit cross-device stat sync requested — honour it
             or self.axis_name is not None
@@ -88,6 +121,20 @@ class BatchNorm(nn.BatchNorm):
             or x.ndim < 2
             or x.shape[0] % groups
         ):
+            if not expected_fallback:
+                # A per-replica context is ACTIVE but this layer cannot
+                # group (e.g. traced batch not divisible by dp shards):
+                # statistics silently become global-batch (sync-BN) —
+                # different training semantics than the engine believes.
+                # Surface it once per gating reason (ADVICE r4).
+                warnings.warn(
+                    f"per_replica_bn({groups}) active but BatchNorm "
+                    f"'{self.name}' fell back to global-batch statistics "
+                    f"(x.shape={x.shape}, axis={self.axis}, "
+                    f"axis_name={self.axis_name}, mask={mask is not None}) "
+                    "— training semantics are sync-BN for this layer.",
+                    stacklevel=2,
+                )
             return super().__call__(
                 x, use_running_average=use_running_average, mask=mask
             )
@@ -100,8 +147,8 @@ class BatchNorm(nn.BatchNorm):
         )
         reduction_axes = tuple(range(1, xg.ndim - 1))
         mean, var = flax_norm._compute_stats(
-            xg,
-            reduction_axes,
+            x=xg,
+            axes=reduction_axes,
             dtype=self.dtype,
             use_fast_variance=self.use_fast_variance,
             force_float32_reductions=self.force_float32_reductions,
@@ -123,19 +170,19 @@ class BatchNorm(nn.BatchNorm):
         ra_var.value = m * ra_var.value + (1 - m) * jnp.mean(var, axis=0)
 
         y = flax_norm._normalize(
-            self,
-            xg,
-            mean,
-            var,
-            reduction_axes,
-            (xg.ndim - 1,),
-            self.dtype,
-            self.param_dtype,
-            self.epsilon,
-            self.use_bias,
-            self.use_scale,
-            self.bias_init,
-            self.scale_init,
-            self.force_float32_reductions,
+            mdl=self,
+            x=xg,
+            mean=mean,
+            var=var,
+            reduction_axes=reduction_axes,
+            feature_axes=(xg.ndim - 1,),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            epsilon=self.epsilon,
+            use_bias=self.use_bias,
+            use_scale=self.use_scale,
+            bias_init=self.bias_init,
+            scale_init=self.scale_init,
+            force_float32_reductions=self.force_float32_reductions,
         )
         return y.reshape(x.shape)

@@ -94,6 +94,24 @@ def allreduce_sum(x: PyTree, axis_name: str = "data") -> PyTree:
     return lax.psum(x, axis_name)
 
 
+def hierarchical_allreduce_gradients(
+    grads: PyTree,
+    ici_axis: str = "data",
+    dcn_axis: str = "replica",
+) -> PyTree:
+    """Two-stage gradient mean for hybrid DCN×ICI meshes: reduce within
+    the slice first (ICI), then across slices (DCN).
+
+    Numerically identical to ``lax.pmean(grads, (dcn_axis, ici_axis))``
+    (mean of means over equal-sized groups == global mean) but states the
+    hierarchy explicitly: the in-slice stage moves each gradient byte over
+    ICI once, and only the already-reduced tensor crosses DCN. This is
+    the TPU analogue of Horovod's hierarchical allreduce
+    (``HOROVOD_HIERARCHICAL_ALLREDUCE``) which reduced intra-node over
+    NVLink before ringing inter-node (SURVEY.md §2a)."""
+    return lax.pmean(lax.pmean(grads, ici_axis), dcn_axis)
+
+
 # ---------------------------------------------------------------------------
 # Host-level collectives (out-of-step uses)
 # ---------------------------------------------------------------------------

@@ -101,6 +101,24 @@ def mesh_from_config(config) -> Mesh:
     ``mesh_shape`` when set (e.g. ``MESH_AXES=data,model MESH_SHAPE=2,4``
     for the pjit engine), axes-only otherwise (all devices on the last
     axis), else all devices on ``data``."""
+    if tuple(config.mesh_axes)[:1] == (REPLICA_AXIS,):
+        # MESH_AXES=replica,... — multi-slice: replica is the DCN axis and
+        # must be built via the hybrid constructor so slice grouping is
+        # honoured. MESH_SHAPE[0] fixes the slice count; default = 2 when
+        # unspecified (all devices when replica is the only axis).
+        inner_axes = tuple(config.mesh_axes)[1:]
+        if config.mesh_shape is not None:
+            if len(config.mesh_shape) != len(config.mesh_axes):
+                raise ValueError(
+                    f"MESH_SHAPE {config.mesh_shape} and MESH_AXES "
+                    f"{config.mesh_axes} must have the same length"
+                )
+            num_slices = config.mesh_shape[0]
+            inner_shape = config.mesh_shape[1:]
+        else:
+            num_slices = 2 if inner_axes else len(jax.devices())
+            inner_shape = None
+        return create_hybrid_mesh(num_slices, axes=inner_axes, shape=inner_shape)
     if config.mesh_shape is not None:
         if len(config.mesh_shape) != len(config.mesh_axes):
             raise ValueError(
@@ -112,6 +130,62 @@ def mesh_from_config(config) -> Mesh:
         # MESH_AXES without MESH_SHAPE: let create_mesh infer the split.
         return create_mesh(axes=config.mesh_axes)
     return data_parallel_mesh()
+
+
+def create_hybrid_mesh(
+    num_slices: int,
+    *,
+    axes: Sequence[str] = (DATA_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: ``replica`` (DCN, outermost) × ICI axes (inner).
+
+    The reference reaches multi-node scale by listing hosts in
+    ``--hostfile`` and letting NCCL ring over the inter-node fabric
+    (``Horovod*/01_Train*.ipynb`` cell 15). The TPU equivalent of "more
+    nodes" is more *slices* joined by DCN, which is an order of magnitude
+    slower than intra-slice ICI — so the slice axis must be the OUTERMOST
+    mesh dim: GSPMD then decomposes a ``("replica", "data")`` reduction
+    into in-slice reduce (ICI) + one cross-slice transfer per hop (DCN)
+    rather than ringing every gradient byte over DCN (SURVEY.md §2a;
+    scaling-book recipe).
+
+    Devices are grouped into slices by their hardware slice when the
+    runtime exposes it (``Device.slice_index`` on real multi-slice TPU
+    jobs), else contiguously in (process, id) order — which is exactly
+    the virtual-device layout used by the CPU-mesh tests and matches
+    ``mesh_utils.create_hybrid_device_mesh``'s fallback contract.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_slices <= 0 or len(devs) % num_slices:
+        raise ValueError(
+            f"{len(devs)} devices do not split into {num_slices} slices"
+        )
+    per_slice = len(devs) // num_slices
+    if all(getattr(d, "slice_index", None) is not None for d in devs):
+        order = sorted(devs, key=lambda d: (d.slice_index, d.id))
+        slice_ids = sorted({d.slice_index for d in devs})
+        if len(slice_ids) != num_slices:
+            raise ValueError(
+                f"hardware reports {len(slice_ids)} slices, asked for {num_slices}"
+            )
+    else:
+        order = sorted(devs, key=lambda d: (getattr(d, "process_index", 0), d.id))
+    inner_axes = tuple(axes)
+    if REPLICA_AXIS in inner_axes:
+        raise ValueError("'replica' is implicit (outermost); pass inner axes only")
+    if shape is not None:
+        inner_shape = tuple(shape)
+    elif inner_axes:
+        inner_shape = (1,) * (len(inner_axes) - 1) + (-1,)
+    else:
+        # Pure-replica mesh (axes=()): every device is its own "slice" —
+        # per_slice must be 1 (resolve_shape enforces prod(())==per_slice).
+        inner_shape = ()
+    resolved = MeshConfig(axes=inner_axes, shape=inner_shape).resolve_shape(per_slice)
+    device_array = np.asarray(order).reshape((num_slices,) + resolved)
+    return Mesh(device_array, (REPLICA_AXIS,) + inner_axes)
 
 
 def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:

@@ -247,6 +247,22 @@ def make_train_step(
     axis = axes if len(axes) > 1 else axes[0]
     base_rng = jax.random.PRNGKey(cfg.seed)
 
+    def _pmean_batch(tree):
+        # Hybrid DCN×ICI mesh (axes "replica","data"): stage the reduction
+        # in-slice first so only slice-reduced tensors cross DCN
+        # (collectives.hierarchical_allreduce_gradients). Single-axis
+        # meshes keep the flat pmean.
+        if isinstance(axis, tuple) and axis[0] == "replica":
+            from distributeddeeplearning_tpu.parallel.collectives import (
+                hierarchical_allreduce_gradients,
+            )
+
+            inner = axis[1:]
+            return hierarchical_allreduce_gradients(
+                tree, ici_axis=inner if len(inner) > 1 else inner[0]
+            )
+        return lax.pmean(tree, axis)
+
     def _device_index():
         return flat_axis_index(mesh, axes)
 
@@ -292,18 +308,18 @@ def make_train_step(
             params_v
         )
         # THE collective: Horovod's per-tensor ring allreduce becomes one
-        # in-step pmean that XLA schedules onto ICI.
-        grads = lax.pmean(grads, axis)
-        new_bs = lax.pmean(new_bs, axis)  # keep replicated state invariant
+        # in-step pmean that XLA schedules onto ICI (staged ICI→DCN on
+        # hybrid multi-slice meshes).
+        grads = _pmean_batch(grads)
+        new_bs = _pmean_batch(new_bs)  # keep replicated state invariant
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
 
         hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
         accuracy = jnp.mean((jnp.argmax(logits, -1) == hard).astype(jnp.float32))
-        metrics = lax.pmean(
-            {"loss": loss, "accuracy": accuracy, "grad_norm": optax.global_norm(grads)},
-            axis,
+        metrics = _pmean_batch(
+            {"loss": loss, "accuracy": accuracy, "grad_norm": optax.global_norm(grads)}
         )
         new_state = state.replace(
             step=state.step + 1,

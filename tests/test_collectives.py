@@ -106,3 +106,164 @@ def test_eval_step_requires_batch_axis(devices):
     mesh = Mesh(np.asarray(jax.devices()), ("model",))
     with pytest.raises(ValueError, match="batch axis"):
         make_eval_step(ResNet(depth=18), mesh)
+
+
+# ---------------------------------------------------------------------------
+# Hybrid DCN×ICI multi-slice mesh (round 5 — SURVEY.md §2a "ICI
+# (intra-slice) and DCN (multi-slice)")
+# ---------------------------------------------------------------------------
+
+def test_hybrid_mesh_layout(devices):
+    from distributeddeeplearning_tpu.parallel.mesh import create_hybrid_mesh
+
+    mesh = create_hybrid_mesh(2)
+    assert mesh.axis_names == ("replica", "data")
+    assert mesh.shape["replica"] == 2 and mesh.shape["data"] == 4
+    # Slices are contiguous in (process, id) order: slice 0 holds the
+    # first 4 device ids — the virtual-device stand-in for hardware
+    # slice grouping (Device.slice_index on real multi-slice jobs).
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert sorted(ids[0].tolist()) == sorted(d.id for d in devices[:4])
+    assert sorted(ids[1].tolist()) == sorted(d.id for d in devices[4:])
+
+
+def test_hybrid_mesh_inner_axes(devices):
+    from distributeddeeplearning_tpu.parallel.mesh import create_hybrid_mesh
+
+    mesh = create_hybrid_mesh(2, axes=("data", "model"), shape=(2, 2))
+    assert mesh.axis_names == ("replica", "data", "model")
+    assert dict(mesh.shape) == {"replica": 2, "data": 2, "model": 2}
+
+
+def test_hybrid_mesh_rejects_bad_args(devices):
+    import pytest
+
+    from distributeddeeplearning_tpu.parallel.mesh import create_hybrid_mesh
+
+    with pytest.raises(ValueError, match="slices"):
+        create_hybrid_mesh(3)  # 8 devices don't split into 3 slices
+    with pytest.raises(ValueError, match="implicit"):
+        create_hybrid_mesh(2, axes=("replica", "data"))
+
+
+def test_mesh_from_config_builds_hybrid(devices):
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.parallel.mesh import mesh_from_config
+
+    cfg = TrainConfig(mesh_axes=("replica", "data"), mesh_shape=(2, 4))
+    mesh = mesh_from_config(cfg)
+    assert mesh.axis_names == ("replica", "data")
+    assert mesh.shape["replica"] == 2 and mesh.shape["data"] == 4
+
+
+def test_hierarchical_pmean_matches_flat(devices):
+    """Staged in-slice→cross-slice mean == single global mean (mean of
+    means over equal groups), on a (replica=2, data=4) hybrid mesh."""
+    from distributeddeeplearning_tpu.parallel.mesh import create_hybrid_mesh
+
+    mesh = create_hybrid_mesh(2)
+    x = jnp.arange(8.0)
+    spec = P(("replica", "data"))
+
+    hier = jax.jit(
+        jax.shard_map(
+            lambda v: collectives.hierarchical_allreduce_gradients(v),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+        )
+    )
+    flat = jax.jit(
+        jax.shard_map(
+            lambda v: jax.lax.pmean(v, ("replica", "data")),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+        )
+    )
+    np.testing.assert_allclose(np.asarray(hier(x)), np.full(8, 3.5))
+    np.testing.assert_allclose(np.asarray(hier(x)), np.asarray(flat(x)))
+
+
+def test_hybrid_train_step_runs_and_matches_dp(devices):
+    """ONE train step on the hybrid (2-slice) mesh equals the same step on
+    the flat dp mesh: hierarchy changes the reduction order, not the
+    math. Also asserts the batch rides both axes."""
+    import optax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+    from distributeddeeplearning_tpu.parallel.mesh import (
+        create_hybrid_mesh,
+        data_parallel_mesh,
+    )
+    from distributeddeeplearning_tpu.training import (
+        create_train_state,
+        make_train_step,
+    )
+    from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+    vocab, t = 64, 16
+    cfg = TrainConfig(model="lm_tiny", num_classes=vocab, batch_size_per_device=2)
+    model = TransformerLM(variant="tiny", vocab_size=vocab, max_seq_len=t)
+    tx = optax.sgd(0.1)
+    rng = np.random.RandomState(11)
+    rows = rng.randint(0, vocab, size=(16, t + 1)).astype(np.int32)
+
+    results = {}
+    for name, mesh in (
+        ("hybrid", create_hybrid_mesh(2)),
+        ("flat", data_parallel_mesh()),
+    ):
+        state = replicate_state(
+            create_train_state(
+                model, cfg, tx, input_shape=(1, t), input_dtype=jnp.int32
+            ),
+            mesh,
+        )
+        batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh)
+        if name == "hybrid":
+            assert tuple(batch[0].sharding.spec) == (("replica", "data"),)
+        step = make_train_step(model, tx, mesh, cfg, donate_state=False)
+        new_state, metrics = step(state, batch)
+        results[name] = (
+            float(metrics["loss"]),
+            np.asarray(
+                jax.tree.leaves(new_state.params)[0], dtype=np.float32
+            ),
+        )
+    assert np.isfinite(results["hybrid"][0])
+    np.testing.assert_allclose(
+        results["hybrid"][0], results["flat"][0], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        results["hybrid"][1], results["flat"][1], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_mesh_from_config_pure_replica(devices):
+    # Regression (round-5 review): MESH_AXES=replica alone must build a
+    # pure-replica mesh (every device its own slice), not crash on an
+    # empty inner-shape expression.
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.parallel.mesh import (
+        batch_sharding,
+        mesh_from_config,
+    )
+
+    mesh = mesh_from_config(TrainConfig(mesh_axes=("replica",)))
+    assert mesh.axis_names == ("replica",)
+    assert mesh.shape["replica"] == 8
+    assert batch_sharding(mesh).spec == P("replica")
+
+
+def test_mesh_from_config_hybrid_shape_mismatch(devices):
+    import pytest
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.parallel.mesh import mesh_from_config
+
+    cfg = TrainConfig(mesh_axes=("replica", "data"), mesh_shape=(2,))
+    with pytest.raises(ValueError, match="same length"):
+        mesh_from_config(cfg)
