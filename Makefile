@@ -21,7 +21,8 @@ SCRIPT            ?= examples/imagenet_keras_tpu.py
 JOB               ?= ddl-train
 PY                ?= python
 
-.PHONY: build login push run jupyter smoke test test-fast notebooks bench \
+.PHONY: build login push run jupyter smoke test test-fast test-smoke \
+        notebooks bench recertify decode-audit \
         native provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -54,17 +55,28 @@ smoke:
 	    --env BATCHSIZE=4 --env IMAGE_SIZE=32 --env NUM_CLASSES=8 \
 	    --env MODEL=resnet18 $(SCRIPT)
 
-test:
+test:	## full suite (~52 min on a 1-vCPU host; see docs/TESTING.md)
 	$(PY) -m pytest tests/ -x -q
 
-test-fast:
-	$(PY) -m pytest tests/ -x -q -k "not two_process"
+test-fast:	## deselect the measured-heavy oracles (tests/heavy_tests.txt)
+	$(PY) -m pytest tests/ -x -q -m "not heavy"
+
+test-smoke:	## sub-minute loop: pure-host logic + mesh/collective semantics
+	$(PY) -m pytest tests/test_collectives.py tests/test_config.py \
+	    tests/test_timer.py tests/test_env_utils.py tests/test_schedules.py \
+	    tests/test_synthetic_data.py tests/test_native.py -x -q
 
 notebooks:	## execute the notebook tier headlessly; fails on any broken cell
 	$(PY) scripts/run_notebooks.py
 
 bench:
 	$(PY) bench.py
+
+recertify:	## all headline protocols at one HEAD -> RECERT.json (round 5)
+	$(PY) scripts/recertify.py
+
+decode-audit:	## decode-tier roofline + batch sweep (round 5)
+	$(PY) scripts/decode_audit.py
 
 ## Native IO tier (built on demand by the Python bindings too)
 native:

@@ -3,11 +3,13 @@
 The reference is a training tutorial with no inference path; a complete
 framework needs one. TPU-first design:
 
-* **KV cache with static shapes** — cache buffers are allocated at full
-  ``max_seq_len`` by ``model.init`` on a full-length dummy, and a
-  position mask hides the unwritten tail (``models/vit.Attention``
-  ``decode=True``). No dynamic shapes, so the whole generation loop
-  compiles to one XLA program.
+* **KV cache with static shapes** — cache buffers are allocated at the
+  REQUEST length (prompt + ``max_new_tokens``; round 5 — previously
+  ``max_seq_len``, which over-read 16× for a 4k-context model emitting
+  256 tokens), and a position mask hides the unwritten tail
+  (``models/vit.Attention`` ``decode=True``). No dynamic shapes, so the
+  whole generation loop compiles to one XLA program, and buffer length
+  IS the per-step KV byte cost (``scripts/decode_audit.py``).
 * **One jitted program** — prefill (the whole prompt in one forward)
   followed by a ``lax.scan`` over single-token decode steps; sampling
   (greedy / temperature / top-k / top-p nucleus) happens on-device
@@ -48,9 +50,17 @@ def _sample(
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     neg_inf = jnp.finfo(jnp.float32).min
     logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_p is None:
+        # top-k alone needs only the k-th value, not a sorted vocab:
+        # lax.top_k is O(V·log k)-ish on TPU vs a full [B, V] sort every
+        # generated token (this runs inside the decode scan).
+        k = min(top_k, logits.shape[-1])
+        kth = lax.top_k(logits, k)[0][:, -1][:, None]
+        return jax.random.categorical(
+            rng, jnp.where(logits < kth, neg_inf, logits), axis=-1
+        ).astype(jnp.int32)
     if top_k is not None or top_p is not None:
-        # one descending sort serves both filters (this runs per token
-        # inside the decode scan — don't sort twice)
+        # one descending sort serves both filters (don't sort twice)
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
     if top_k is not None:
         # top_k >= vocab keeps everything (validated > 0 in generate())
@@ -139,12 +149,17 @@ def generate(
         return cached(params, jnp.asarray(prompt, jnp.int32), rng)
     decode_model = model.clone(decode=True, attn_impl="xla", seq_axis=None)
 
-    # Shape-only trace of init sizes the KV caches (full-length buffers);
-    # the actual cache is just zeros of those shapes — no parameter
-    # initializers or forward compute ever run for it.
+    # Shape-only trace of init sizes the KV caches; the actual cache is
+    # just zeros of those shapes — no parameter initializers or forward
+    # compute ever run for it. Buffers are sized to THIS REQUEST
+    # (prompt + max_new_tokens), not model.max_seq_len: decode attention
+    # streams the whole static buffer every step (position-masked), so a
+    # 4k-context model generating 256 tokens would otherwise pay 16× the
+    # KV bytes — and decode is KV/weight-bandwidth-bound
+    # (scripts/decode_audit.py).
     cache_shapes = jax.eval_shape(
         lambda r: decode_model.init(
-            r, jnp.zeros((b, max_len or total), jnp.int32), train=False
+            r, jnp.zeros((b, total), jnp.int32), train=False
         ),
         jax.random.PRNGKey(0),
     )["cache"]

@@ -55,13 +55,22 @@ _GROUPS: contextvars.ContextVar[int] = contextvars.ContextVar(
 )
 
 
+_FLAX_API_CHECKED = False
+
+
 def _check_flax_private_api() -> None:
     """The grouped path reuses flax's private ``_compute_stats`` /
     ``_normalize`` so per-group math is bit-identical to what
     ``nn.BatchNorm`` runs per shard under the dp engine. Private API can
     drift between flax minors — verify the parameter names we pass (all
-    passed by keyword below) at import so a signature break fails HERE
-    with a actionable message, not mid-trace (ADVICE r4)."""
+    passed by keyword below) at the FIRST GROUPED USE, so a signature
+    break fails here with an actionable message instead of mid-call-
+    convention breakage (ADVICE r4) — and only for users of this path:
+    checking at import would make the whole models package unimportable
+    for e.g. LM inference, which never groups."""
+    global _FLAX_API_CHECKED
+    if _FLAX_API_CHECKED:
+        return
     need_stats = {"x", "axes", "dtype", "use_fast_variance",
                   "force_float32_reductions"}
     need_norm = {"mdl", "x", "mean", "var", "reduction_axes", "feature_axes",
@@ -73,15 +82,13 @@ def _check_flax_private_api() -> None:
     if missing:
         import flax
 
-        raise ImportError(
+        raise RuntimeError(
             f"flax {flax.__version__} changed the private normalization API "
-            f"this module's grouped-BN path relies on (missing params: "
+            f"the grouped-BN path relies on (missing params: "
             f"{sorted(missing)}). Re-check models/norm.py against "
             "flax.linen.normalization."
         )
-
-
-_check_flax_private_api()
+    _FLAX_API_CHECKED = True
 
 
 @contextlib.contextmanager
@@ -139,6 +146,7 @@ class BatchNorm(nn.BatchNorm):
                 x, use_running_average=use_running_average, mask=mask
             )
 
+        _check_flax_private_api()
         xg = x.reshape(groups, x.shape[0] // groups, *x.shape[1:])
         # Pin the group axis to the batch mesh axes: each group's
         # statistics reduction stays local to its data shard.

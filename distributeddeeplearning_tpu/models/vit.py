@@ -48,8 +48,48 @@ from distributeddeeplearning_tpu.models.sharding import (  # noqa: F401
 )
 
 
+class _FusedGradDense(nn.Dense):
+    """``nn.Dense`` whose backward computes dW and db in ONE pass over
+    the upstream gradient (``ops/pallas/fused_grads.bias_dense``) instead
+    of XLA's matmul + separate bias-grad reduction. Same param names,
+    shapes, and init — checkpoint-compatible with ``nn.Dense``. dp-engine
+    experiment (the Pallas custom call is opaque to GSPMD); enabled via
+    ``FUSED_DENSE_GRAD=1``."""
+
+    @nn.compact
+    def __call__(self, inputs):
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (inputs.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = self.param(
+            "bias", self.bias_init, (self.features,), self.param_dtype
+        )
+        from distributeddeeplearning_tpu.ops.pallas import fused_grads
+
+        if fused_grads.gspmd_active():
+            # Inside a pjit-partitioned trace the Pallas custom call is
+            # opaque to GSPMD — keep the stock XLA dense (same forward
+            # numerics; backward is XLA's).
+            return (
+                jnp.dot(inputs.astype(self.dtype), nn.unbox(kernel).astype(self.dtype))
+                + nn.unbox(bias).astype(self.dtype)
+            )
+        interpret = jax.default_backend() != "tpu"
+        return fused_grads.bias_dense(
+            inputs, nn.unbox(kernel), nn.unbox(bias), self.dtype, interpret
+        )
+
+
 def _dense(features, name, kernel_axes, dtype, use_bias=True):
-    return nn.Dense(
+    import os
+
+    cls = nn.Dense
+    if use_bias and os.environ.get("FUSED_DENSE_GRAD", "") == "1":
+        cls = _FusedGradDense
+    return cls(
         features,
         dtype=dtype,
         param_dtype=jnp.float32,

@@ -1,0 +1,204 @@
+"""Fused dW+db backward for Dense layers (round-5 experiment).
+
+The round-4 ViT and LM traces blamed ~12 ms/step (ViT-B/16, b=256) on
+separate bias-grad reduction passes: for every Dense, XLA emits
+
+    dW = x^T @ g          (matmul, reads x and g)
+    db = sum(g, axes=BT)  (loop fusion, reads g AGAIN)
+
+so the upstream-gradient tensor ``g`` — the largest activation-sized
+tensor in the backward — is streamed from HBM twice. This kernel
+computes both outputs in ONE pass over ``g``: a contraction-tiled
+matmul whose accumulator loop also folds the row-sum ``db`` into a VMEM
+scratch, eliminating the second read.
+
+Design (same playbook as ``flash_packed.py``):
+
+* grid ``(num_m, num_n)`` — ``num_n`` (innermost, sequential) walks the
+  contraction dimension N = B·T in ``bn``-row blocks; ``num_m`` tiles
+  wide outputs (qkv/mlp) so the f32 accumulator ``[K, bm]`` stays well
+  inside VMEM.
+* accumulators persist across the sequential grid: zeroed at ``ni==0``,
+  emitted at ``ni==num_n-1`` (dW f32 and db f32 — param-grad dtype).
+* ragged N tail is masked in-kernel (OOB reads can be NaN and poison
+  the contraction — round-4 lesson), so no host-side padding copy.
+
+Trade-off stated up front: when M needs ``num_m > 1`` tiles, ``x`` is
+re-read ``num_m`` times (vs once for XLA's own matmul), so the net
+saving is ``g_bytes - (num_m-1)·x_bytes`` per layer — positive for
+every Dense in the ViT/LM blocks (g is the wider operand exactly when
+num_m > 1). Kept FLAG-OFF (``FUSED_DENSE_GRAD=1``) until the on-chip
+measurement says it wins, like ``depthwise.py``/``fused_block.py``
+(PROFILE.md protocol).
+
+Reference anchor: the reference leaves all backward scheduling to
+cuDNN/XLA (SURVEY.md §2d); this tier is our own standard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Trace-time marker set by the GSPMD (pjit) engine around model.apply /
+# init: the Pallas custom call below is OPAQUE to the SPMD partitioner,
+# so consumers (models/vit._FusedGradDense) must fall back to the stock
+# XLA dense inside a pjit-partitioned program and use the fused backward
+# only under the shard_map (dp) engine, where the kernel sees per-device
+# shards.
+_GSPMD_TRACE: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "gspmd_trace", default=False
+)
+
+
+@contextlib.contextmanager
+def gspmd_trace():
+    token = _GSPMD_TRACE.set(True)
+    try:
+        yield
+    finally:
+        _GSPMD_TRACE.reset(token)
+
+
+def gspmd_active() -> bool:
+    return _GSPMD_TRACE.get()
+
+
+def _pick_bm(m: int, k: int) -> int:
+    """Largest lane-aligned divisor of ``m`` keeping the f32 accumulator
+    ``[k, bm]`` ≤ 8 MiB (half of VMEM, leaving room for double-buffered
+    input blocks). m is a multiple of 128 for every model dim in the
+    zoo; fall back to m itself if not."""
+    if m % 128:
+        return m
+    budget = max(128, min(1024, (8 * 2**20 // 4) // max(k, 1) // 128 * 128))
+    for bm in range(min(budget, m), 0, -128):
+        if m % bm == 0:
+            return bm
+    return m
+
+
+def _dw_db_kernel(x_ref, g_ref, dw_ref, db_ref, dw_acc, db_acc, *, n: int,
+                  bn: int):
+    ni = pl.program_id(1)
+
+    @pl.when(ni == 0)
+    def _zero():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    x = x_ref[:]  # [bn, K]
+    g = g_ref[:]  # [bn, bm]
+    # Mask the ragged tail block: rows past N are undefined memory.
+    base = ni * bn
+    if n % bn:
+        rows = base + lax.broadcasted_iota(jnp.int32, (bn, 1), 0)
+        valid = rows < n
+        x = jnp.where(valid, x, jnp.zeros_like(x))
+        g = jnp.where(valid, g, jnp.zeros_like(g))
+    # Contraction over the row (sublane) axis of both operands; f32
+    # accumulation on the MXU.
+    dw_acc[:] += lax.dot_general(
+        x, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    db_acc[:] += jnp.sum(g.astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(ni == pl.num_programs(1) - 1)
+    def _emit():
+        dw_ref[:] = dw_acc[:]
+        db_ref[:] = db_acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def matmul_dw_db(x2d: jnp.ndarray, g2d: jnp.ndarray, *, interpret: bool = False):
+    """``(dW, db) = (x2d^T @ g2d, sum(g2d, axis=0))`` in one pass over g.
+
+    ``x2d``: [N, K], ``g2d``: [N, M] (any float dtype; bf16 in the mixed-
+    precision step). Returns f32 ``[K, M]`` and ``[M]``.
+    """
+    n, k = x2d.shape
+    n2, m = g2d.shape
+    assert n == n2, (x2d.shape, g2d.shape)
+    # Smaller row blocks for wide-K layers: the x block [bn, K] must
+    # double-buffer alongside the [K, bm] accumulator.
+    bn = 256 if k > 2048 else 512
+    if n < bn:
+        bn = max(8, (n + 7) // 8 * 8)
+    bm = _pick_bm(m, k)
+    num_n = (n + bn - 1) // bn
+    num_m = m // bm
+    kernel = functools.partial(_dw_db_kernel, n=n, bn=bn)
+    dw, db = pl.pallas_call(
+        kernel,
+        grid=(num_m, num_n),
+        in_specs=[
+            pl.BlockSpec((bn, k), lambda mi, ni: (ni, 0)),
+            pl.BlockSpec((bn, bm), lambda mi, ni: (ni, mi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, bm), lambda mi, ni: (0, mi)),
+            pl.BlockSpec((1, bm), lambda mi, ni: (0, mi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, m), jnp.float32),
+            jax.ShapeDtypeStruct((1, m), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, bm), jnp.float32),
+            pltpu.VMEM((1, bm), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x2d, g2d)
+    return dw, db[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def bias_dense(x, kernel, bias, compute_dtype=jnp.bfloat16,
+               interpret: bool = False):
+    """``x @ kernel + bias`` with the fused dW+db backward.
+
+    Forward is the plain XLA matmul (same numerics as ``nn.Dense`` with
+    ``dtype=compute_dtype``: operands cast to the compute dtype, bias
+    added in it). Backward computes dx via XLA and (dW, db) via
+    :func:`matmul_dw_db` — one read of g instead of two.
+
+    Note: the Pallas custom call is opaque to GSPMD — it runs under the
+    shard_map (dp) engine, where the kernel sees per-device shards. The
+    pjit engine wraps its traces in :func:`gspmd_trace`, and
+    ``models/vit._FusedGradDense`` checks :func:`gspmd_active` to fall
+    back to the stock XLA dense inside those traces.
+    """
+    xc = x.astype(compute_dtype)
+    kc = kernel.astype(compute_dtype)
+    y = jnp.dot(xc, kc)
+    return y + bias.astype(compute_dtype)
+
+
+def _bias_dense_fwd(x, kernel, bias, compute_dtype, interpret):
+    return (
+        bias_dense(x, kernel, bias, compute_dtype, interpret),
+        (x, kernel),
+    )
+
+
+def _bias_dense_bwd(compute_dtype, interpret, res, gy):
+    x, kernel = res
+    gc = gy.astype(compute_dtype)
+    dx = jnp.dot(gc, kernel.astype(compute_dtype).T).astype(x.dtype)
+    x2d = x.reshape(-1, x.shape[-1]).astype(compute_dtype)
+    g2d = gc.reshape(-1, gy.shape[-1])
+    dw, db = matmul_dw_db(x2d, g2d, interpret=interpret)
+    return dx, dw.astype(kernel.dtype), db.astype(kernel.dtype)
+
+
+bias_dense.defvjp(_bias_dense_fwd, _bias_dense_bwd)

@@ -116,7 +116,15 @@ def mesh_from_config(config) -> Mesh:
             num_slices = config.mesh_shape[0]
             inner_shape = config.mesh_shape[1:]
         else:
-            num_slices = 2 if inner_axes else len(jax.devices())
+            # No MESH_SHAPE: default to 2 slices when the device count
+            # splits, else degrade to a single slice — a (1, N) mesh,
+            # the pre-round-5 axes-only behaviour — so odd/single-device
+            # boxes keep working.
+            n = len(jax.devices())
+            if inner_axes:
+                num_slices = 2 if n % 2 == 0 else 1
+            else:
+                num_slices = n
             inner_shape = None
         return create_hybrid_mesh(num_slices, axes=inner_axes, shape=inner_shape)
     if config.mesh_shape is not None:

@@ -46,6 +46,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributeddeeplearning_tpu.ops.pallas.fused_grads import gspmd_trace
 from distributeddeeplearning_tpu.parallel.mesh import (
     batch_sharding as _mesh_batch_sharding,
 )
@@ -133,7 +134,7 @@ def create_sharded_train_state(
     active_rules = list(rules_for_mesh(mesh, tuple(rules)))
 
     def init_fn(r):
-        with nn.logical_axis_rules(active_rules):
+        with nn.logical_axis_rules(active_rules), gspmd_trace():
             variables = model.init(r, jnp.zeros(shape, input_dtype), train=False)
         params = lax.with_sharding_constraint(
             nn.unbox(variables["params"]), param_shardings
@@ -224,7 +225,8 @@ def make_pjit_train_step(
             # The rules context makes in-model nn.with_logical_constraint
             # calls real (MoE's expert-major activation layout — the
             # all-to-all boundary); without it they are silent no-ops.
-            with mesh, nn.logical_axis_rules(rules), per_replica_bn(bn_groups):
+            with mesh, nn.logical_axis_rules(rules), per_replica_bn(bn_groups), \
+                    gspmd_trace():
                 logits, mutated = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
                     images,
@@ -291,7 +293,7 @@ def make_pjit_eval_step(
         labels = lax.with_sharding_constraint(labels, batch_sharding)
         weights = lax.with_sharding_constraint(weights, batch_sharding)
         images = normalize_staged_images(images)  # uint8 staging
-        with mesh, nn.logical_axis_rules(rules):
+        with mesh, nn.logical_axis_rules(rules), gspmd_trace():
             logits = model.apply(
                 {"params": state.params, "batch_stats": state.batch_stats},
                 images,

@@ -215,10 +215,14 @@ def _pallas_interpreted(model) -> bool:
     checking on — verified on hardware. Covers both explicit kernel
     impls ("pallas" = streaming flash, "fused" = packed small-T); "auto"
     resolves to "xla" off-TPU (models/vit.py) and needs no exception."""
-    return (
-        getattr(model, "attn_impl", None) in ("pallas", "fused")
-        and jax.default_backend() != "tpu"
+    import os
+
+    uses_pallas = getattr(model, "attn_impl", None) in ("pallas", "fused") or (
+        # FUSED_DENSE_GRAD=1 routes every Dense backward through a Pallas
+        # kernel (models/vit._FusedGradDense) — same interpreter caveat.
+        os.environ.get("FUSED_DENSE_GRAD", "") == "1"
     )
+    return uses_pallas and jax.default_backend() != "tpu"
 
 
 def make_train_step(

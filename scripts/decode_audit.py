@@ -1,0 +1,166 @@
+"""Decode-path audit (VERDICT r4 #6): roofline position + batch sweep.
+
+The decode tier's 11.7k tok/s (b=8) was the only number in BASELINE.md
+with no PROFILE.md account behind it. This script gives it one, using the
+same method as the trainer audits: an analytic byte floor, a measured
+sweep, and (optionally) a trace.
+
+**Byte floor.** Autoregressive decode is memory-bound: each step must
+stream (a) every parameter and (b) the KV cache past. With this repo's
+static cache design (``inference.py`` — buffers allocated at the
+request length prompt+new, position mask hides the unwritten tail), the
+attention reads the FULL buffer every step regardless of how many
+tokens are valid yet, so with max_len = prompt_len + new_tokens:
+
+    bytes/step  =  param_bytes + kv_cache_bytes(max_len)
+    tok/s floor =  batch * HBM_BW / bytes_per_step
+
+Batch amortizes the parameter (and, less obviously, nothing else: the KV
+cache scales WITH batch, so at large b the cache term dominates and
+tok/s/seq degrades). The sweep shows exactly where that crossover sits.
+
+Usage::
+
+    python scripts/decode_audit.py [--model lm_small] [--prompt-len 128]
+        [--new-tokens 128] [--batches 1,2,4,8,16,32,64]
+        [--profile-dir /tmp/decode_trace]
+
+Prints a per-batch table and ONE summary JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+HBM_GBPS = 819.0  # v5e (PROFILE.md constant used by every trainer audit)
+
+
+def tree_bytes(tree) -> int:
+    import jax
+
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree)
+    )
+
+
+def audit(model_name: str, prompt_len: int, new_tokens: int,
+          batches, profile_dir=None, vocab: int = 32000):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.inference import generate
+    from distributeddeeplearning_tpu.models import get_model
+
+    max_len = prompt_len + new_tokens
+    model = get_model(model_name, num_classes=vocab, max_seq_len=max_len)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.PRNGKey(0), jnp.zeros((1, max_len), jnp.int32),
+        train=False,
+    )
+    params = nn.unbox(variables["params"])
+    param_bytes = tree_bytes(params)
+
+    # KV-cache bytes for batch b: shape-only trace of the decode clone's
+    # init (exactly how inference.generate sizes its buffers).
+    decode_model = model.clone(decode=True, attn_impl="xla", seq_axis=None)
+
+    def cache_bytes(b: int) -> int:
+        shapes = jax.eval_shape(
+            lambda r: decode_model.init(
+                r, jnp.zeros((b, max_len), jnp.int32), train=False
+            ),
+            jax.random.PRNGKey(0),
+        )["cache"]
+        return sum(
+            math.prod(s.shape) * np.dtype(s.dtype).itemsize
+            for s in jax.tree.leaves(shapes)
+        )
+
+    rows = []
+    platform = jax.devices()[0].platform
+    print(f"# {model_name} decode audit on {platform}: params "
+          f"{param_bytes / 2**20:.1f} MiB, max_len {max_len}", flush=True)
+    print(f"# {'b':>4} {'tok/s':>10} {'tok/s/seq':>10} {'floor tok/s':>12} "
+          f"{'% of floor':>10} {'cache MiB':>10}", flush=True)
+    import contextlib
+
+    for i, b in enumerate(batches):
+        kv = cache_bytes(b)
+        bytes_per_step = param_bytes + kv
+        floor = b * HBM_GBPS * 1e9 / bytes_per_step
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(0, vocab, size=(b, prompt_len)).astype(np.int32)
+        kw = dict(max_new_tokens=new_tokens, temperature=0.8, top_k=40,
+                  rng=jax.random.PRNGKey(1))
+        out = generate(model, params, prompt, **kw)  # compile + warmup
+        int(np.asarray(out)[0, -1])
+        prof = (
+            jax.profiler.trace(os.path.join(profile_dir, f"b{b}"))
+            if profile_dir else contextlib.nullcontext()
+        )
+        reps = 3
+        with prof:
+            t0 = time.perf_counter()
+            for r in range(reps):
+                out = generate(model, params, prompt,
+                               **{**kw, "rng": jax.random.PRNGKey(2 + r)})
+            int(np.asarray(out)[0, -1])  # host readback fence
+            dt = time.perf_counter() - t0
+        tps = reps * b * new_tokens / dt
+        pct = 100.0 * tps / floor
+        rows.append({
+            "batch": b,
+            "tokens_per_sec": round(tps, 1),
+            "tokens_per_sec_per_seq": round(tps / b, 1),
+            "bytes_per_step_mb": round(bytes_per_step / 2**20, 1),
+            "kv_cache_mb": round(kv / 2**20, 1),
+            "floor_tokens_per_sec": round(floor, 1),
+            "pct_of_floor": round(pct, 1),
+        })
+        print(f"  {b:>4} {tps:>10.1f} {tps / b:>10.1f} {floor:>12.1f} "
+              f"{pct:>9.1f}% {kv / 2**20:>10.1f}", flush=True)
+    return {
+        "audit": f"{model_name}_decode",
+        "platform": platform,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "param_bytes_mb": round(param_bytes / 2**20, 1),
+        "hbm_gbps": HBM_GBPS,
+        "sweep": rows,
+    }
+
+
+def main(argv=None) -> int:
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="lm_small")
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=128)
+    p.add_argument("--batches", default="1,2,4,8,16,32,64")
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--profile-dir", default=None)
+    args = p.parse_args(argv)
+    batches = [int(b) for b in args.batches.split(",") if b.strip()]
+    out = audit(args.model, args.prompt_len, args.new_tokens, batches,
+                profile_dir=args.profile_dir, vocab=args.vocab)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -267,3 +267,36 @@ def test_mesh_from_config_hybrid_shape_mismatch(devices):
     cfg = TrainConfig(mesh_axes=("replica", "data"), mesh_shape=(2,))
     with pytest.raises(ValueError, match="same length"):
         mesh_from_config(cfg)
+
+
+def test_hybrid_mesh_pjit_engine_step(devices):
+    """The GSPMD engine on a hybrid (replica,data) mesh: the rules table
+    maps "batch" over BOTH axes, so one step runs with the DCN axis
+    outermost — no engine changes needed."""
+    import optax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+    from distributeddeeplearning_tpu.parallel.mesh import create_hybrid_mesh
+    from distributeddeeplearning_tpu.training.pjit_step import (
+        build_pjit_state,
+        make_pjit_train_step,
+    )
+
+    mesh = create_hybrid_mesh(2)
+    vocab, t = 64, 16
+    cfg = TrainConfig(num_classes=vocab, batch_size_per_device=2, engine="pjit")
+    model = TransformerLM(variant="tiny", vocab_size=vocab, max_seq_len=t)
+    tx = optax.sgd(0.1)
+    state = build_pjit_state(
+        model, cfg, tx, mesh, input_shape=(1, t), input_dtype=jnp.int32
+    )
+    rng = np.random.RandomState(13)
+    rows = rng.randint(0, vocab, size=(16, t + 1)).astype(np.int32)
+    step = make_pjit_train_step(model, tx, mesh, cfg, donate_state=False)
+    with mesh:
+        batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh)
+        assert tuple(batch[0].sharding.spec) == (("replica", "data"),)
+        _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

@@ -213,3 +213,51 @@ def test_tp_sharded_state_decodes_token_identically(devices):
         generate(model, host_params, prompt, max_new_tokens=8)
     )
     np.testing.assert_array_equal(sharded_out, ref_out)
+
+
+def test_cache_buffers_sized_to_request_not_max_seq_len():
+    """Round 5: KV buffers are allocated at prompt+max_new_tokens, not
+    model.max_seq_len — decode streams the whole static buffer every
+    step, so buffer length IS the KV byte cost (scripts/decode_audit.py).
+    Shape-only check via the same eval_shape the sampler uses."""
+    model = _model()  # max_seq_len = 32
+    decode_model = model.clone(decode=True, attn_impl="xla", seq_axis=None)
+    b, total = 2, 12  # 5-token prompt + 7 new << max_seq_len
+    shapes = jax.eval_shape(
+        lambda r: decode_model.init(
+            r, jnp.zeros((b, total), jnp.int32), train=False
+        ),
+        jax.random.PRNGKey(0),
+    )["cache"]
+    lengths = {
+        leaf.shape[1] for leaf in jax.tree.leaves(shapes) if leaf.ndim >= 3
+    }
+    assert lengths == {total}, lengths
+    # and generation at that size still matches the full re-forward
+    params = _params(model)
+    prompt = np.random.RandomState(5).randint(
+        0, VOCAB, size=(b, 5)
+    ).astype(np.int32)
+    got = np.asarray(generate(model, params, prompt, max_new_tokens=7))
+    np.testing.assert_array_equal(got, _greedy_reference(model, params, prompt, 7))
+
+
+def test_topk_fast_path_matches_sort_reference():
+    """Round 5: the top-k-only sampler uses lax.top_k instead of a full
+    vocab sort — the filtered distribution (and hence the draw, same
+    key) must be identical to the sort-based construction."""
+    from distributeddeeplearning_tpu.inference import _sample
+
+    rng = np.random.RandomState(7)
+    logits = jnp.asarray(rng.randn(3, 101).astype(np.float32) * 4)
+    key = jax.random.PRNGKey(9)
+    for k in (1, 5, 40, 101, 500):
+        got = _sample(logits, key, temperature=0.7, top_k=k)
+        scaled = logits / 0.7
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+        kth = srt[:, min(k, scaled.shape[-1]) - 1][:, None]
+        ref_logits = jnp.where(
+            scaled < kth, jnp.finfo(jnp.float32).min, scaled
+        )
+        ref = jax.random.categorical(key, ref_logits, axis=-1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

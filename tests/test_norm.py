@@ -87,12 +87,17 @@ def test_context_restores_on_exit():
 def test_indivisible_batch_falls_back():
     """B % G != 0 cannot be grouped — defer to plain BatchNorm rather
     than crash (the engine only requests G that divides the batch, but
-    the module must stay safe standalone)."""
+    the module must stay safe standalone) AND surface the semantics
+    downgrade with a warning (ADVICE r4: the silent sync-BN fallback
+    must be visible)."""
+    import pytest
+
     x = jnp.asarray(np.random.RandomState(3).randn(6, 4).astype(np.float32))
     ours = BatchNorm(use_running_average=False)
     ref = nn.BatchNorm(use_running_average=False)
     variables = _init(ref, x)
     with per_replica_bn(4):
-        y, _ = ours.apply(variables, x, mutable=["batch_stats"])
+        with pytest.warns(UserWarning, match="sync-BN"):
+            y, _ = ours.apply(variables, x, mutable=["batch_stats"])
     y_ref, _ = ref.apply(variables, x, mutable=["batch_stats"])
     np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
