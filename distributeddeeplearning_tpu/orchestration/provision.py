@@ -82,6 +82,41 @@ def storage_commands(
     return cmds
 
 
+def _create_command(
+    tpu: str,
+    zone: str,
+    *,
+    num_slices: int = 1,
+    accelerator_type: str = "v5litepod-8",
+    version: str = DEFAULT_RUNTIME,
+    project: Optional[str] = None,
+    spot: bool = False,
+) -> List[str]:
+    """One builder for both creation shapes (single-slice ``tpu-vm
+    create`` vs multi-slice ``queued-resources create``) so creation
+    flags never drift between the two."""
+    if num_slices > 1:
+        cmd = _gcloud(
+            "compute", "tpus", "queued-resources", "create", tpu,
+            f"--zone={zone}",
+            f"--node-count={num_slices}",
+            f"--accelerator-type={accelerator_type}",
+            f"--runtime-version={version}",
+            project=project,
+        )
+    else:
+        cmd = _gcloud(
+            "compute", "tpus", "tpu-vm", "create", tpu,
+            f"--zone={zone}",
+            f"--accelerator-type={accelerator_type}",
+            f"--version={version}",
+            project=project,
+        )
+    if spot:
+        cmd.append("--spot")
+    return cmd
+
+
 def pod_create_command(
     tpu: str,
     zone: str,
@@ -93,16 +128,129 @@ def pod_create_command(
 ) -> List[str]:
     """Pod-slice creation (reference cell 39's ``az batchai cluster
     create --min N --max N`` — fixed-size by construction on TPU)."""
-    cmd = _gcloud(
-        "compute", "tpus", "tpu-vm", "create", tpu,
-        f"--zone={zone}",
-        f"--accelerator-type={accelerator_type}",
-        f"--version={version}",
-        project=project,
+    return _create_command(
+        tpu, zone, num_slices=1, accelerator_type=accelerator_type,
+        version=version, project=project, spot=spot,
     )
-    if spot:
-        cmd.append("--spot")
-    return cmd
+
+
+def multislice_create_command(
+    tpu: str,
+    zone: str,
+    *,
+    num_slices: int,
+    accelerator_type: str = "v5litepod-8",
+    version: str = DEFAULT_RUNTIME,
+    project: Optional[str] = None,
+    spot: bool = False,
+) -> List[str]:
+    """Multi-slice provisioning: ONE queued resource with ``node-count``
+    DCN-connected slices (the TPU analogue of the reference growing its
+    cluster beyond one node, `01_CreateResources.ipynb` cell 39's
+    ``--min/--max``). A job on this topology builds the replica-outermost
+    hybrid mesh (``parallel/mesh.create_hybrid_mesh``; slice grouping
+    comes from ``Device.slice_index``) so gradient reduction rides ICI
+    in-slice before crossing DCN (SURVEY.md §2a)."""
+    return _create_command(
+        tpu, zone, num_slices=num_slices, accelerator_type=accelerator_type,
+        version=version, project=project, spot=spot,
+    )
+
+
+def multislice_node_names(tpu: str, num_slices: int) -> List[str]:
+    """A queued resource named ``tpu`` materialises its slices as nodes
+    ``tpu-0 … tpu-(N-1)`` — per-node commands (setup scp/ssh, submit)
+    target these, never the queued-resource name itself."""
+    return [f"{tpu}-{i}" for i in range(num_slices)]
+
+
+def parse_slices(value, *, source: str = ".env SLICES") -> int:
+    """SLICES as recorded by ``pod-create`` — user-editable state, so a
+    malformed value gets an actionable error, not an int() traceback."""
+    if value is None or value == "":
+        return 1
+    try:
+        n = int(str(value).strip())
+    except ValueError:
+        raise SystemExit(
+            f"malformed {source}={value!r}: expected an integer slice "
+            "count (re-run pod-create, or fix the .env entry)"
+        )
+    return max(n, 1)
+
+
+def multislice_describe_command(
+    tpu: str, zone: str, project: Optional[str] = None
+) -> List[str]:
+    return _gcloud(
+        "compute", "tpus", "queued-resources", "describe", tpu,
+        f"--zone={zone}", project=project,
+    )
+
+
+def multislice_delete_command(
+    tpu: str, zone: str, project: Optional[str] = None
+) -> List[str]:
+    """``--force`` tears down the slices the queued resource owns —
+    deleting only `tpu-vm` nodes would leak the billable resource."""
+    return _gcloud(
+        "compute", "tpus", "queued-resources", "delete", tpu,
+        f"--zone={zone}", "--force", "--quiet", project=project,
+    )
+
+
+def wait_for_multislice(
+    tpu: str,
+    zone: str,
+    *,
+    project: Optional[str] = None,
+    dry_run: bool = False,
+    timeout_s: float = 3600.0,
+    poll_s: float = 30.0,
+    sink=None,
+) -> int:
+    """Poll the queued resource until ACTIVE. Unlike the blocking
+    ``tpu-vm create``, ``queued-resources create`` returns as soon as the
+    request is ACCEPTED — running ``setup`` before the slices exist would
+    burn its ssh retries against nothing. FAILED/SUSPENDED states abort
+    with rc 1."""
+    sink = sink or sys.stdout
+    cmd = multislice_describe_command(tpu, zone, project=project) + [
+        "--format=value(state.state)"
+    ]
+    sink.write(_fmt(cmd) + f"  # poll until ACTIVE (≤{timeout_s:.0f}s)\n")
+    if dry_run:
+        return 0
+    deadline = time.monotonic() + timeout_s
+    consecutive_errors = 0
+    while True:
+        r = subprocess.run(list(cmd), capture_output=True, text=True)
+        if r.returncode != 0:
+            # Surface the real error (auth expiry, wrong project) instead
+            # of polling blind for an hour; tolerate a couple of
+            # transient blips before giving up.
+            consecutive_errors += 1
+            err = (r.stderr or "").strip().splitlines()
+            sink.write(
+                f"describe failed (rc={r.returncode}, "
+                f"{consecutive_errors}/3): {err[-1] if err else '?'}\n"
+            )
+            if consecutive_errors >= 3:
+                sink.write("ERROR: queued-resource describe keeps failing\n")
+                return r.returncode or 1
+        else:
+            consecutive_errors = 0
+            out = r.stdout.strip().upper()
+            sink.write(f"queued-resource state: {out or '?'}\n")
+            if "ACTIVE" in out:
+                return 0
+            if "FAILED" in out or "SUSPENDED" in out:
+                sink.write(f"ERROR: queued resource entered {out}\n")
+                return 1
+        if time.monotonic() >= deadline:
+            sink.write(f"ERROR: not ACTIVE after {timeout_s:.0f}s\n")
+            return 1
+        time.sleep(poll_s)
 
 
 def pod_describe_command(
@@ -307,13 +455,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             p.add_argument("--accelerator-type", default="v5litepod-8")
             p.add_argument("--version", default=DEFAULT_RUNTIME)
             p.add_argument("--spot", action="store_true")
+            p.add_argument(
+                "--slices", type=int, default=1,
+                help="multi-slice: provision N DCN-connected slices via a "
+                     "queued resource (train with MESH_AXES=replica,data)",
+            )
         if name == "setup":
             p.add_argument("--bucket", default=None)
             p.add_argument("--image", default=None)
             p.add_argument("--repo-dir", default=".")
+        if name in ("pod-status", "pod-delete", "setup"):
+            p.add_argument(
+                "--slices", type=int, default=None,
+                help="override the .env SLICES record (multi-slice pods)",
+            )
 
     args = ap.parse_args(argv)
     project = args.project or _env_default("PROJECT", args.env_file)
+
+    def _slices() -> int:
+        # pod-create records SLICES in .env; the other lifecycle verbs
+        # read it back so they target the right resource kind.
+        if getattr(args, "slices", None):
+            return args.slices
+        return parse_slices(_env_default("SLICES", args.env_file))
 
     import functools
 
@@ -340,10 +505,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             env = dotenv_for(args.env_file)
             set_key(env, "TPU_NAME", tpu)
             set_key(env, "ZONE", zone)
-        return run_pod_create(
-            pod_create_command(
+            set_key(env, "SLICES", str(args.slices))
+        rc = run_pod_create(
+            _create_command(
                 tpu,
                 zone,
+                num_slices=args.slices,
                 accelerator_type=args.accelerator_type,
                 version=args.version,
                 project=project,
@@ -351,22 +518,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ),
             args.dry_run,
         )
+        if rc == 0 and args.slices > 1:
+            # queued-resources create returns at ACCEPTED; block here so
+            # the documented next step (`setup`) meets live slices.
+            rc = wait_for_multislice(
+                tpu, zone, project=project, dry_run=args.dry_run
+            )
+        return rc
+    slices = _slices()
     if args.cmd == "pod-status":
-        return run(
-            [pod_describe_command(tpu, zone, project=project)], args.dry_run
+        status_cmd = (
+            multislice_describe_command(tpu, zone, project=project)
+            if slices > 1
+            else pod_describe_command(tpu, zone, project=project)
         )
+        return run([status_cmd], args.dry_run)
     if args.cmd == "pod-delete":
-        return run(
-            [pod_delete_command(tpu, zone, project=project)], args.dry_run
+        delete_cmd = (
+            multislice_delete_command(tpu, zone, project=project)
+            if slices > 1
+            else pod_delete_command(tpu, zone, project=project)
         )
+        return run([delete_cmd], args.dry_run)
     if args.cmd == "setup":
-        return run(
-            setup_commands(
-                tpu, zone, bucket=args.bucket, image=args.image,
-                repo_dir=args.repo_dir, project=project,
-            ),
-            args.dry_run,
-        )
+        # Multi-slice: the queued resource's nodes are tpu-0…tpu-(N-1);
+        # run the full worker bring-up against EACH node (each is its own
+        # tpu-vm as far as ssh/scp are concerned).
+        nodes = multislice_node_names(tpu, slices) if slices > 1 else [tpu]
+        cmds = []
+        for node in nodes:
+            cmds.extend(
+                setup_commands(
+                    node, zone, bucket=args.bucket, image=args.image,
+                    repo_dir=args.repo_dir, project=project,
+                )
+            )
+        return run(cmds, args.dry_run)
     return 2
 
 

@@ -212,6 +212,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     stp.add_argument("--job", required=True)
     stp.add_argument("--worker", default="0")
     stp.add_argument("--no-follow", action="store_true")
+    stp.add_argument(
+        "--slice", type=int, default=0,
+        help="multi-slice pods: which slice's node to stream from",
+    )
 
     for name in ("status", "stop"):
         c = sub.add_parser(name)
@@ -225,38 +229,79 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not tpu or not zone:
         ap.error("--tpu/--zone required (or TPU_NAME/ZONE in .env)")
 
+    # Multi-slice pods (provision pod-create --slices N): TPU_NAME is the
+    # queued-resource name; every ssh-level action targets its nodes
+    # tpu-0…tpu-(N-1) instead.
+    from distributeddeeplearning_tpu.orchestration.provision import (
+        multislice_node_names,
+        parse_slices,
+    )
+
+    slices = parse_slices(envfile.get("SLICES"))
+    nodes = multislice_node_names(tpu, slices) if slices > 1 else [tpu]
+
     if args.cmd == "run":
         job = args.job or f"job-{int(time.time())}"
         env = _parse_env(args.env)
-        cmd = submit_commands(
-            job, args.script, args.script_args,
-            tpu=tpu, zone=zone, project=project, env=env,
-            detach=args.detach, image=args.image,
-        )
+        if len(nodes) > 1 and not args.detach:
+            ap.error(
+                "multi-slice submit requires --detach: all slices must "
+                "launch concurrently (a foreground run on slice 0 would "
+                "block the others and the DCN-joined job would never form)"
+            )
+        cmds = [
+            submit_commands(
+                job, args.script, args.script_args,
+                tpu=node, zone=zone, project=project, env=env,
+                detach=args.detach, image=args.image,
+            )
+            for node in nodes
+        ]
         manifest = build_manifest(
             job, args.script, args.script_args,
-            tpu=tpu, zone=zone, env=env, detach=args.detach, command=cmd,
+            tpu=tpu, zone=zone, env=env, detach=args.detach, command=cmds[0],
         )
+        if len(nodes) > 1:
+            manifest["slices"] = len(nodes)
+            manifest["nodes"] = nodes
         if args.manifest:
             write_json_to_file(manifest, args.manifest)
-        print(" ".join(shlex.quote(c) for c in cmd))
+        for cmd in cmds:
+            print(" ".join(shlex.quote(c) for c in cmd))
         if args.dry_run:
             return 0
-        return _call_surfaced(cmd)
+        for cmd in cmds:
+            rc = _call_surfaced(cmd)
+            if rc:
+                return rc
+        return 0
 
     if args.cmd == "stream":
-        cmd = stream_command(
-            args.job, tpu=tpu, zone=zone, worker=args.worker,
-            project=project, follow=not args.no_follow,
-        )
+        node = nodes[min(args.slice, len(nodes) - 1)]
+        cmds = [
+            stream_command(
+                args.job, tpu=node, zone=zone, worker=args.worker,
+                project=project, follow=not args.no_follow,
+            )
+        ]
     else:
-        cmd = control_command(
-            args.job, args.cmd, tpu=tpu, zone=zone, project=project
-        )
-    print(" ".join(shlex.quote(c) for c in cmd))
+        # status/stop address every slice's node — a half-stopped
+        # multi-slice job would wedge the survivors at the next collective.
+        cmds = [
+            control_command(
+                args.job, args.cmd, tpu=node, zone=zone, project=project
+            )
+            for node in nodes
+        ]
+    for cmd in cmds:
+        print(" ".join(shlex.quote(c) for c in cmd))
     if args.dry_run:
         return 0
-    return _call_surfaced(cmd)
+    for cmd in cmds:
+        rc = _call_surfaced(cmd)
+        if rc:
+            return rc
+    return 0
 
 
 if __name__ == "__main__":

@@ -229,3 +229,84 @@ def test_smoke_and_frontend_notebooks_are_valid():
     for needle in ("keras_style", "Estimator", "explicit.setup",
                    "loop.fit", "pp_schedule='1f1b'"):
         assert needle in joined, needle
+
+
+def test_multislice_create_command_and_cli(capsys, tmp_path, monkeypatch):
+    """--slices N provisions one queued resource with N DCN-connected
+    slices (round 5; trains with MESH_AXES=replica,data over the hybrid
+    mesh), and the dry-run plan includes the ACTIVE-wait poll (the
+    queued create returns at ACCEPTED, unlike the blocking tpu-vm
+    create)."""
+    c = provision.multislice_create_command(
+        "ms", "us-west4-a", num_slices=4, accelerator_type="v5litepod-16"
+    )
+    joined = " ".join(c)
+    assert "queued-resources create ms" in joined
+    assert "--node-count=4" in c
+    assert "--accelerator-type=v5litepod-16" in c
+    monkeypatch.chdir(tmp_path)
+    rc = provision.main(
+        ["--tpu", "ms", "--zone", "z", "--dry-run", "pod-create",
+         "--slices", "2"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "queued-resources create ms" in out and "--node-count=2" in out
+    assert "poll until ACTIVE" in out
+    # --slices 1 keeps the plain tpu-vm create path
+    rc = provision.main(
+        ["--tpu", "ms", "--zone", "z", "--dry-run", "pod-create"]
+    )
+    assert rc == 0
+    assert "tpu-vm create ms" in capsys.readouterr().out
+
+
+def test_multislice_lifecycle_targets_queued_resource(capsys, tmp_path,
+                                                      monkeypatch):
+    """status/delete/setup on a multi-slice pod must target the queued
+    resource (delete --force tears down its slices; tpu-vm commands
+    would 404 — the nodes are named ms-0…ms-(N-1))."""
+    monkeypatch.chdir(tmp_path)
+    assert provision.multislice_node_names("ms", 2) == ["ms-0", "ms-1"]
+    for argv, want in (
+        (["--tpu", "ms", "--zone", "z", "--dry-run", "pod-status",
+          "--slices", "2"], "queued-resources describe ms"),
+        (["--tpu", "ms", "--zone", "z", "--dry-run", "pod-delete",
+          "--slices", "2"], "queued-resources delete ms"),
+    ):
+        assert provision.main(argv) == 0
+        assert want in capsys.readouterr().out
+    # setup fans the full bring-up out over every node
+    assert provision.main(
+        ["--tpu", "ms", "--zone", "z", "--dry-run", "setup", "--slices", "2"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "ms-0:" in out and "ms-1:" in out  # scp staging per node
+    # delete --force --quiet present on the queued-resource delete
+    d = provision.multislice_delete_command("ms", "z")
+    assert "--force" in d and "--quiet" in d
+
+
+def test_multislice_slices_recorded_and_read_from_env(capsys, tmp_path,
+                                                      monkeypatch):
+    """pod-create records SLICES in .env (alongside TPU_NAME/ZONE) and
+    later lifecycle verbs read it back without an explicit --slices."""
+    monkeypatch.chdir(tmp_path)
+    called = []
+    monkeypatch.setattr(
+        provision, "run_pod_create", lambda cmd, dry_run, sink=None:
+        called.append(tuple(cmd)) or 0,
+    )
+    monkeypatch.setattr(
+        provision, "wait_for_multislice",
+        lambda *a, **k: 0,
+    )
+    rc = provision.main(
+        ["--tpu", "ms", "--zone", "z", "pod-create", "--slices", "2"]
+    )
+    assert rc == 0 and "--node-count=2" in called[0]
+    env = (tmp_path / ".env").read_text()
+    assert "SLICES=2" in env and "TPU_NAME=ms" in env
+    # no --slices flag: pod-status picks the env record up
+    assert provision.main(["--zone", "z", "--dry-run", "pod-status"]) == 0
+    assert "queued-resources describe ms" in capsys.readouterr().out

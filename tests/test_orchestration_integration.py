@@ -39,6 +39,13 @@ FAKE_GCLOUD = textwrap.dedent(
                     sys.stderr.write(rule.get("stderr", "transient\\n"))
                     sys.exit(rule.get("rc", 255))
                 break
+            if "stdout_seq" in rule:  # scripted per-call outputs
+                cf = rule["counter"]
+                n = int(open(cf).read()) if os.path.exists(cf) else 0
+                open(cf, "w").write(str(n + 1))
+                seq = rule["stdout_seq"]
+                sys.stdout.write(seq[min(n, len(seq) - 1)])
+                sys.exit(rule.get("rc", 0))
             sys.stdout.write(rule.get("stdout", ""))
             sys.stderr.write(rule.get("stderr", ""))
             sys.exit(rule.get("rc", 0))
@@ -221,3 +228,77 @@ def test_foreground_submit_failure_rc_surfaces(fake_gcloud, tmp_path, capsys):
     assert rc == 7
     err = capsys.readouterr().err
     assert "ERROR: command failed (rc=7)" in err
+
+
+def test_multislice_wait_polls_until_active(fake_gcloud, tmp_path, capsys):
+    """wait_for_multislice really POLLS: the fake scripts a
+    PROVISIONING → PROVISIONING → ACTIVE sequence, so the loop must
+    iterate three times before returning 0. FAILED aborts with rc 1, and
+    persistent describe errors fail fast with the stderr surfaced
+    (instead of polling blind for the full timeout)."""
+    fake_gcloud.set_rules([
+        {
+            "match": "queued-resources describe",
+            "stdout_seq": ["PROVISIONING\n", "PROVISIONING\n", "ACTIVE\n"],
+            "counter": str(tmp_path / "seq_counter"),
+        },
+    ])
+    rc = provision.wait_for_multislice(
+        "ms", "z", timeout_s=5.0, poll_s=0.01
+    )
+    assert rc == 0
+    describes = [
+        c for c in fake_gcloud.calls() if "queued-resources" in " ".join(c)
+    ]
+    assert len(describes) == 3, describes
+    out = capsys.readouterr().out
+    assert out.count("PROVISIONING") == 2 and "ACTIVE" in out
+
+    fake_gcloud.set_rules([
+        {"match": "queued-resources describe", "stdout": "FAILED\n"},
+    ])
+    assert provision.wait_for_multislice("ms", "z", timeout_s=5.0,
+                                         poll_s=0.01) == 1
+    assert "FAILED" in capsys.readouterr().out
+
+    fake_gcloud.set_rules([
+        {"match": "queued-resources describe", "rc": 1,
+         "stderr": "ERROR: (gcloud.auth) token expired\n"},
+    ])
+    assert provision.wait_for_multislice("ms", "z", timeout_s=60.0,
+                                         poll_s=0.01) == 1
+    out = capsys.readouterr().out
+    assert "token expired" in out and "keeps failing" in out
+
+
+def test_multislice_submit_targets_every_node(fake_gcloud, tmp_path,
+                                              monkeypatch, capsys):
+    """submit on a multi-slice pod fans run/status/stop over the nodes
+    tpu-0…tpu-(N-1) (TPU_NAME is the queued-resource name, which no
+    tpu-vm command can address) and requires --detach for run."""
+    envf = tmp_path / ".env"
+    envf.write_text("TPU_NAME=ms\nZONE=z\nSLICES=2\n")
+    flags = ["--env-file", str(envf)]
+    rc = submit.main(flags + ["--dry-run"] + [
+        "run", "--detach", "--job", "j1",
+        "--manifest", str(tmp_path / "m.json"),
+        "examples/imagenet_keras_tpu.py",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ssh ms-0" in out and "ssh ms-1" in out
+    manifest = json.loads((tmp_path / "m.json").read_text())
+    assert manifest["slices"] == 2 and manifest["nodes"] == ["ms-0", "ms-1"]
+    # foreground run is refused — all slices must launch concurrently
+    with pytest.raises(SystemExit):
+        submit.main(flags + ["--dry-run", "run", "--job", "j2", "x.py"])
+    capsys.readouterr()
+    # status loops every node; stream picks one slice
+    assert submit.main(flags + ["--dry-run", "status", "--job", "j1"]) == 0
+    out = capsys.readouterr().out
+    assert "ssh ms-0" in out and "ssh ms-1" in out
+    assert submit.main(
+        flags + ["--dry-run", "stream", "--job", "j1", "--slice", "1"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "ssh ms-1" in out and "ms-0" not in out
