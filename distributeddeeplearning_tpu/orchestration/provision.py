@@ -283,6 +283,7 @@ def setup_commands(
     repo_dir: str = ".",
     workdir: str = "~/ddl",
     project: Optional[str] = None,
+    smoke: str = "global",
 ) -> List[List[str]]:
     """Worker bring-up — the ``nodeprep.sh`` + ``docker.service`` analogue
     (reference cluster_config; SURVEY §2 "Cluster node setup") plus the
@@ -322,11 +323,23 @@ def setup_commands(
             f"{workdir}/data"
         )
     if not image:
-        ssh_steps.append(
-            'python3 -c "import jax; jax.distributed.initialize(); '
-            "print('worker', jax.process_index(), 'of', jax.process_count(), "
-            "'sees', jax.device_count(), 'global devices')\""
-        )
+        if smoke == "local":
+            # Multi-slice bring-up runs node-by-node: the global
+            # jax.distributed.initialize() barrier spans ALL slices'
+            # processes, so a per-node sequential setup would hang on it
+            # (the job-level global check happens at submit time, when
+            # every slice launches concurrently). Check only this
+            # node's chips.
+            ssh_steps.append(
+                'python3 -c "import jax; '
+                "print('local devices:', jax.local_device_count())\""
+            )
+        else:
+            ssh_steps.append(
+                'python3 -c "import jax; jax.distributed.initialize(); '
+                "print('worker', jax.process_index(), 'of', jax.process_count(), "
+                "'sees', jax.device_count(), 'global devices')\""
+            )
     cmds.extend(
         ssh_command(tpu, zone, step, project=project) for step in ssh_steps
     )
@@ -551,6 +564,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 setup_commands(
                     node, zone, bucket=args.bucket, image=args.image,
                     repo_dir=args.repo_dir, project=project,
+                    # node-by-node bring-up cannot run the GLOBAL
+                    # device-count smoke on a multi-slice pod: its
+                    # jax.distributed.initialize() barrier spans slices
+                    # whose setup hasn't started yet (see setup_commands)
+                    smoke="local" if slices > 1 else "global",
                 )
             )
         return run(cmds, args.dry_run)

@@ -270,14 +270,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(" ".join(shlex.quote(c) for c in cmd))
         if args.dry_run:
             return 0
-        for cmd in cmds:
+        for i, cmd in enumerate(cmds):
             rc = _call_surfaced(cmd)
             if rc:
+                if i > 0:
+                    # Slices 0..i-1 already hold a detached job waiting at
+                    # the DCN join for the slice that never launched.
+                    print(
+                        f"ERROR: launch failed on {nodes[i]} after "
+                        f"{i} slice(s) started — the partial job will "
+                        f"wedge at jax.distributed.initialize(); run "
+                        f"`submit stop --job {job}` to clean up",
+                        file=sys.stderr,
+                    )
                 return rc
         return 0
 
     if args.cmd == "stream":
-        node = nodes[min(args.slice, len(nodes) - 1)]
+        if not 0 <= args.slice < len(nodes):
+            ap.error(
+                f"--slice {args.slice} out of range: this pod has "
+                f"{len(nodes)} slice(s) (valid: 0..{len(nodes) - 1})"
+            )
+        node = nodes[args.slice]
         cmds = [
             stream_command(
                 args.job, tpu=node, zone=zone, worker=args.worker,
@@ -297,11 +312,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(" ".join(shlex.quote(c) for c in cmd))
     if args.dry_run:
         return 0
+    # status/stop must reach EVERY node even if one fails — returning on
+    # the first error would leave a half-stopped multi-slice job wedged
+    # at its next collective (first nonzero rc reported at the end).
+    first_rc = 0
     for cmd in cmds:
         rc = _call_surfaced(cmd)
-        if rc:
-            return rc
-    return 0
+        first_rc = first_rc or rc
+    return first_rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,87 @@
+"""A/B the fused dW+db dense backward (VERDICT r4 #5) on the bench
+protocols it targets: ViT-B/16 (the trace that named the ~12 ms of
+bias-grad reduction passes) and lm_small @1k (same reduction class).
+
+Runs each protocol twice in fresh subprocesses — stock, then
+``FUSED_DENSE_GRAD=1`` — and prints the paired numbers + delta. The
+kernel is kept only if this says it wins (PROFILE.md protocol, like the
+depthwise/fused-block write-ups).
+
+Usage::
+
+    python scripts/fused_grads_ab.py [--timeout 900]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROTOCOLS = {
+    "vit_b16": {"BENCH_MODEL": "vit_b16", "BENCH_BATCH": "256"},
+    "lm_small_1k": {
+        "BENCH_MODEL": "lm_small", "BENCH_SEQ_LEN": "1024", "BENCH_BATCH": "8",
+    },
+}
+
+
+def run_once(env_over: dict, timeout_s: float) -> dict:
+    env = dict(os.environ)
+    env.update(env_over)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout {timeout_s:.0f}s"}
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    return json.loads(lines[-1]) if lines else {
+        "error": f"no JSON; rc={r.returncode}", "stderr": r.stderr[-300:],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--only", default=None)
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VAL",
+        help="override protocol env (e.g. --set BENCH_BATCH=2 for smoke)",
+    )
+    args = p.parse_args(argv)
+    names = (
+        [n.strip() for n in args.only.split(",")] if args.only
+        else list(PROTOCOLS)
+    )
+    overrides = dict(kv.split("=", 1) for kv in args.set)
+    results = {}
+    for name in names:
+        row = {}
+        for label, extra in (("stock", {"FUSED_DENSE_GRAD": ""}),
+                             ("fused", {"FUSED_DENSE_GRAD": "1"})):
+            rec = run_once(
+                {**PROTOCOLS[name], **overrides, **extra}, args.timeout
+            )
+            row[label] = rec.get("value", 0.0)
+            row[f"{label}_rec"] = rec
+            print(f"{name} {label}: {row[label]}", flush=True)
+        if row["stock"] > 0 and row["fused"] > 0:
+            row["delta_pct"] = round(
+                100.0 * (row["fused"] - row["stock"]) / row["stock"], 2
+            )
+        results[name] = row
+    print(json.dumps({
+        n: {k: v for k, v in r.items() if not k.endswith("_rec")}
+        for n, r in results.items()
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

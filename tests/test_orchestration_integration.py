@@ -302,3 +302,63 @@ def test_multislice_submit_targets_every_node(fake_gcloud, tmp_path,
     ) == 0
     out = capsys.readouterr().out
     assert "ssh ms-1" in out and "ms-0" not in out
+
+
+def test_multislice_stop_reaches_all_nodes_despite_failure(
+    fake_gcloud, tmp_path, capsys
+):
+    """stop must address EVERY slice node even when one ssh fails —
+    returning early would leave a half-stopped job wedged at its next
+    collective. First nonzero rc is still reported."""
+    envf = tmp_path / ".env"
+    envf.write_text("TPU_NAME=ms\nZONE=z\nSLICES=2\n")
+    fake_gcloud.set_rules([
+        {"match": "ssh ms-0", "rc": 255, "stderr": "conn refused\n"},
+    ])
+    rc = submit.main(["--env-file", str(envf), "stop", "--job", "j1"])
+    assert rc == 255
+    calls = [" ".join(c) for c in fake_gcloud.calls()]
+    assert any("ssh ms-0" in c for c in calls)
+    assert any("ssh ms-1" in c for c in calls)  # still reached
+
+
+def test_multislice_partial_launch_prints_cleanup_guidance(
+    fake_gcloud, tmp_path, capsys
+):
+    """run --detach failing on slice 1 after slice 0 launched must name
+    the cleanup command — the nohup'd job on slice 0 is wedged at the
+    DCN join."""
+    envf = tmp_path / ".env"
+    envf.write_text("TPU_NAME=ms\nZONE=z\nSLICES=2\n")
+    fake_gcloud.set_rules([
+        {"match": "ssh ms-1", "rc": 255, "stderr": "conn refused\n"},
+    ])
+    rc = submit.main([
+        "--env-file", str(envf), "run", "--detach", "--job", "j9", "x.py",
+    ])
+    assert rc == 255
+    err = capsys.readouterr().err
+    assert "submit stop --job j9" in err and "ms-1" in err
+
+
+def test_multislice_stream_slice_out_of_range_rejected(tmp_path, capsys):
+    envf = tmp_path / ".env"
+    envf.write_text("TPU_NAME=ms\nZONE=z\nSLICES=2\n")
+    with pytest.raises(SystemExit):
+        submit.main(["--env-file", str(envf), "--dry-run",
+                     "stream", "--job", "j1", "--slice", "5"])
+    assert "out of range" in capsys.readouterr().err
+
+
+def test_multislice_setup_uses_local_smoke(fake_gcloud, tmp_path, capsys):
+    """Per-node sequential setup must NOT run the global
+    jax.distributed.initialize() smoke (it would barrier on slices whose
+    setup hasn't started); single-slice setup keeps the global check."""
+    assert provision.main(
+        _flags(tmp_path, "setup", "--slices", "2")
+    ) == 0
+    out = capsys.readouterr().out
+    assert "local_device_count" in out
+    assert "distributed.initialize" not in out
+    assert provision.main(_flags(tmp_path, "setup")) == 0
+    assert "distributed.initialize" in capsys.readouterr().out
