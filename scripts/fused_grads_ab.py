@@ -2,14 +2,17 @@
 protocols it targets: ViT-B/16 (the trace that named the ~12 ms of
 bias-grad reduction passes) and lm_small @1k (same reduction class).
 
-Runs each protocol twice in fresh subprocesses — stock, then
-``FUSED_DENSE_GRAD=1`` — and prints the paired numbers + delta. The
-kernel is kept only if this says it wins (PROFILE.md protocol, like the
-depthwise/fused-block write-ups).
+Runs each protocol twice — stock, then ``FUSED_DENSE_GRAD=1`` — through
+``scripts/recertify.py``'s own protocol table and subprocess runner
+(ONE definition of each certified protocol; this script must measure
+exactly what the battery certifies), and prints the paired numbers +
+delta. The kernel is kept only if this says it wins (PROFILE.md
+protocol, like the depthwise/fused-block write-ups).
 
 Usage::
 
     python scripts/fused_grads_ab.py [--timeout 900]
+        [--only vit_b16,lm_small_1k] [--set BENCH_BATCH=2 ...]
 """
 
 from __future__ import annotations
@@ -17,33 +20,15 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-PROTOCOLS = {
-    "vit_b16": {"BENCH_MODEL": "vit_b16", "BENCH_BATCH": "256"},
-    "lm_small_1k": {
-        "BENCH_MODEL": "lm_small", "BENCH_SEQ_LEN": "1024", "BENCH_BATCH": "8",
-    },
-}
+from scripts.recertify import PROTOCOLS, run_protocol  # noqa: E402
 
-
-def run_once(env_over: dict, timeout_s: float) -> dict:
-    env = dict(os.environ)
-    env.update(env_over)
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            env=env, timeout=timeout_s, capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": f"timeout {timeout_s:.0f}s"}
-    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
-    return json.loads(lines[-1]) if lines else {
-        "error": f"no JSON; rc={r.returncode}", "stderr": r.stderr[-300:],
-    }
+AB_PROTOCOLS = ("vit_b16", "lm_small_1k")
 
 
 def main(argv=None) -> int:
@@ -56,31 +41,44 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
     names = (
-        [n.strip() for n in args.only.split(",")] if args.only
-        else list(PROTOCOLS)
+        [n.strip() for n in args.only.split(",") if n.strip()] if args.only
+        else list(AB_PROTOCOLS)
     )
+    unknown = [n for n in names if n not in PROTOCOLS]
+    if unknown:
+        p.error(f"unknown protocol(s) {unknown}; valid: {sorted(PROTOCOLS)}")
+    bad = [kv for kv in args.set if "=" not in kv]
+    if bad:
+        p.error(f"--set needs KEY=VAL, got {bad}")
     overrides = dict(kv.split("=", 1) for kv in args.set)
+
     results = {}
+    failed = False
     for name in names:
         row = {}
-        for label, extra in (("stock", {"FUSED_DENSE_GRAD": ""}),
-                             ("fused", {"FUSED_DENSE_GRAD": "1"})):
-            rec = run_once(
-                {**PROTOCOLS[name], **overrides, **extra}, args.timeout
+        for label, flag in (("stock", ""), ("fused", "1")):
+            rec = run_protocol(
+                name,
+                {**PROTOCOLS[name], **overrides, "FUSED_DENSE_GRAD": flag},
+                args.timeout,
             )
             row[label] = rec.get("value", 0.0)
-            row[f"{label}_rec"] = rec
-            print(f"{name} {label}: {row[label]}", flush=True)
+            if row[label] <= 0:
+                # surface the failure — a fabricated 0.0 baseline would
+                # silently decide the keep-or-drop question
+                row[f"{label}_error"] = rec.get("error", rec)
+                failed = True
+            print(f"{name} {label}: {row[label]}"
+                  + (f"  ERROR: {row.get(label + '_error')}"
+                     if row[label] <= 0 else ""),
+                  flush=True)
         if row["stock"] > 0 and row["fused"] > 0:
             row["delta_pct"] = round(
                 100.0 * (row["fused"] - row["stock"]) / row["stock"], 2
             )
         results[name] = row
-    print(json.dumps({
-        n: {k: v for k, v in r.items() if not k.endswith("_rec")}
-        for n, r in results.items()
-    }))
-    return 0
+    print(json.dumps(results))
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -300,3 +300,43 @@ def test_hybrid_mesh_pjit_engine_step(devices):
         assert tuple(batch[0].sharding.spec) == (("replica", "data"),)
         _, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_hybrid_mesh_with_tensor_parallel_inner_axes(devices):
+    """DCN×ICI×TP composition: 2 slices × (data=2, model=2) — the ViT
+    TP step runs with replica outermost and the batch riding
+    (replica, data); QKV stays sharded over model."""
+    import optax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.pipeline import shard_batch
+    from distributeddeeplearning_tpu.models.vit import LOGICAL_RULES, ViT
+    from distributeddeeplearning_tpu.parallel.mesh import create_hybrid_mesh
+    from distributeddeeplearning_tpu.training.pjit_step import (
+        create_sharded_train_state,
+        make_pjit_train_step,
+    )
+
+    mesh = create_hybrid_mesh(2, axes=("data", "model"), shape=(2, 2))
+    assert mesh.axis_names == ("replica", "data", "model")
+    cfg = TrainConfig(num_classes=16, image_size=16, batch_size_per_device=2)
+    model = ViT(variant="ti", patch_size=16, num_classes=16, dtype=jnp.bfloat16)
+    tx = optax.sgd(0.1)
+    state = create_sharded_train_state(
+        model, cfg, tx, mesh, LOGICAL_RULES, input_shape=(1, 16, 16, 3)
+    )
+    qkv = state.params["block0"]["attn"]["qkv"]["kernel"]
+    assert tuple(qkv.sharding.spec) == (None, "model"), qkv.sharding
+    rng = np.random.RandomState(17)
+    step = make_pjit_train_step(model, tx, mesh, cfg, donate_state=False)
+    with mesh:
+        batch = shard_batch(
+            (
+                rng.randn(8, 16, 16, 3).astype(np.float32),
+                rng.randint(0, 16, size=(8,)).astype(np.int32),
+            ),
+            mesh,
+        )
+        assert tuple(batch[0].sharding.spec) == (("replica", "data"),)
+        _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
