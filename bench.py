@@ -10,6 +10,12 @@ one v5e chip under the driver, 8 forced CPU devices in dev.
 comparison point is the reference-era per-GPU estimate for its exact stack
 (ResNet50 fp32, per-GPU batch 64, Horovod/V100): ~325 images/sec/GPU.
 ``vs_baseline`` = our images/sec *per chip* / 325.
+
+Every train-protocol line also carries ``compile_sec`` (AOT compile time,
+measured apart from the hot loop — set ``COMPILATION_CACHE_DIR`` to make
+re-runs deserialize instead of recompiling) and ``host_sync_count`` (host
+materialisations inside the measured region; exactly 1 — the closing
+fence — when the loop is sync-free).
 """
 
 from __future__ import annotations
@@ -72,6 +78,8 @@ def run_bench(
     state = replicate_state(create_train_state(model, cfg, tx), mesh)
     step = make_train_step(model, tx, mesh, cfg)
 
+    from distributeddeeplearning_tpu.utils import hostsync
+
     rng = np.random.RandomState(42)
     host_batch = (
         # Staged bf16 (PROFILE.md): model compute dtype, half the transfer.
@@ -82,9 +90,15 @@ def run_bench(
     )
     batch = shard_batch(host_batch, mesh)
 
+    # AOT compile, separately timed: compile cost must never smear into
+    # the measured region, and with a persistent compilation cache
+    # (COMPILATION_CACHE_DIR) re-runs deserialize instead of recompiling.
+    _, compile_sec = step.aot_compile(state, batch)
+
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
-    float(metrics["loss"])  # host readback: drains the device queue
+    # host readback: drains the device queue
+    float(hostsync.device_get(metrics["loss"], label="bench_fence"))
 
     # Fence with a host readback of a value that depends on every step in
     # the chain — block_until_ready alone does not reliably wait through
@@ -96,15 +110,23 @@ def run_bench(
         if profile_dir
         else contextlib.nullcontext()
     )
+    sync0 = hostsync.accountant().count
     with prof:
         t0 = time.perf_counter()
         for _ in range(MEASURE_STEPS):
             state, metrics = step(state, batch)
-        assert np.isfinite(float(metrics["loss"]))
+        assert np.isfinite(
+            float(hostsync.device_get(metrics["loss"], label="bench_fence"))
+        )
         dt = time.perf_counter() - t0
 
     images_per_sec = MEASURE_STEPS * global_batch / dt
-    return images_per_sec, n_dev
+    perf = {
+        "compile_sec": round(compile_sec, 3),
+        # syncs inside the measured region: exactly the closing fence
+        "host_sync_count": int(hostsync.accountant().count - sync0),
+    }
+    return images_per_sec, n_dev, perf
 
 
 def run_lm_bench(
@@ -152,26 +174,38 @@ def run_lm_bench(
         ),
         mesh,
     )
+    from distributeddeeplearning_tpu.utils import hostsync
+
     step = make_train_step(model, tx, mesh, cfg)
     rng = np.random.RandomState(42)
     rows = rng.randint(0, vocab, size=(global_batch, seq_len + 1)).astype(np.int32)
     batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh)
 
+    _, compile_sec = step.aot_compile(state, batch)  # see run_bench
+
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
-    float(metrics["loss"])  # fence (see run_bench)
+    # fence (see run_bench)
+    float(hostsync.device_get(metrics["loss"], label="bench_fence"))
 
     prof = (
         jax.profiler.trace(profile_dir) if profile_dir else contextlib.nullcontext()
     )
+    sync0 = hostsync.accountant().count
     with prof:
         t0 = time.perf_counter()
         for _ in range(MEASURE_STEPS):
             state, metrics = step(state, batch)
-        assert np.isfinite(float(metrics["loss"]))
+        assert np.isfinite(
+            float(hostsync.device_get(metrics["loss"], label="bench_fence"))
+        )
         dt = time.perf_counter() - t0
     tokens_per_sec = MEASURE_STEPS * global_batch * seq_len / dt
-    return tokens_per_sec, n_dev
+    perf = {
+        "compile_sec": round(compile_sec, 3),
+        "host_sync_count": int(hostsync.accountant().count - sync0),
+    }
+    return tokens_per_sec, n_dev, perf
 
 
 def run_decode_bench(model_name: str, batch: int, prompt_len: int, new_tokens: int):
@@ -253,7 +287,7 @@ def lm_main():
     last_err = None
     for per_device_batch in batches:
         try:
-            tps, n_dev = run_lm_bench(
+            tps, n_dev, perf = run_lm_bench(
                 model_name, per_device_batch, seq_len, attn_impl, profile_dir
             )
             print(
@@ -264,6 +298,8 @@ def lm_main():
                         # no reference point: the reference is vision-only
                         "unit": "tokens/sec",
                         "vs_baseline": 0.0,
+                        "compile_sec": perf["compile_sec"],
+                        "host_sync_count": perf["host_sync_count"],
                         "detail": {
                             "devices": n_dev,
                             "per_device_batch": per_device_batch,
@@ -455,6 +491,14 @@ def main():
         # pins jax_platforms at interpreter start, so without this a
         # deliberate CPU run still touches (and can hang on) the relay.
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if os.environ.get("COMPILATION_CACHE_DIR"):
+        # Persistent XLA compilation cache: re-runs (and every protocol
+        # of a recertify battery) deserialize instead of recompiling.
+        from distributeddeeplearning_tpu.training.warmup import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(os.environ["COMPILATION_CACHE_DIR"])
     _guard_device_init()
     if os.environ.get("BENCH_DECODE", "") == "1":
         return decode_main()
@@ -474,7 +518,7 @@ def main():
     bench_kw = dict(model_name=vision_model, depth=depth, image_size=image_size)
     for per_device_batch in batches:
         try:
-            ips, n_dev = run_bench(
+            ips, n_dev, perf = run_bench(
                 per_device_batch, profile_dir=profile_dir, **bench_kw
             )
             per_chip = ips / n_dev
@@ -501,7 +545,7 @@ def main():
                 # images/sec/chip at 1 device vs all attached devices. A
                 # failed rerun must not discard the valid N-device result.
                 try:
-                    ips1, _ = run_bench(per_device_batch, devices=1, **bench_kw)
+                    ips1, _, _ = run_bench(per_device_batch, devices=1, **bench_kw)
                     detail["images_per_sec_1_device"] = round(ips1, 1)
                     detail["scaling_efficiency"] = round(per_chip / ips1, 4)
                 except Exception as e:
@@ -519,6 +563,8 @@ def main():
                         )
                         if canonical
                         else 0.0,
+                        "compile_sec": perf["compile_sec"],
+                        "host_sync_count": perf["host_sync_count"],
                         "detail": detail,
                     }
                 )

@@ -146,6 +146,15 @@ class TrainConfig:
     # in-kernel statistics cannot be batch-split).
     allow_sync_bn: bool = False
 
+    # Cheap-restart knobs: persistent XLA compilation cache directory
+    # (env COMPILATION_CACHE_DIR; None/empty = off) — re-runs of the
+    # same program deserialize executables instead of recompiling — and
+    # AOT warmup (env AOT_WARMUP): compile the train step before the
+    # first batch flows, logging compile seconds + cost-analysis FLOPs
+    # (training/warmup.py).
+    compilation_cache_dir: Optional[str] = None
+    aot_warmup: bool = False
+
     # Bookkeeping
     seed: int = 42  # reference _SEED=42 (PyTorch :274-277, TF fake data :284)
     model_dir: Optional[str] = None  # AZ_BATCHAI_OUTPUT_MODEL equivalent
@@ -292,6 +301,10 @@ class TrainConfig:
             kw["mesh_shape"] = tuple(
                 int(s) for s in e["MESH_SHAPE"].split(",") if s.strip()
             )
+        if "COMPILATION_CACHE_DIR" in e:
+            kw["compilation_cache_dir"] = e["COMPILATION_CACHE_DIR"] or None
+        if "AOT_WARMUP" in e:
+            kw["aot_warmup"] = _str_to_bool(e["AOT_WARMUP"])
         if "SEED" in e:
             kw["seed"] = int(e["SEED"])
         # Smoke-test knobs (not in the reference contract): shrink the

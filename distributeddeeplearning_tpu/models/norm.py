@@ -73,9 +73,12 @@ def _check_flax_private_api() -> None:
         return
     need_stats = {"x", "axes", "dtype", "use_fast_variance",
                   "force_float32_reductions"}
+    # force_float32_reductions is OPTIONAL in _normalize: flax 0.10.x
+    # does the dtype promotion internally and has no such parameter —
+    # _ffr_kwargs() below omits it there.
     need_norm = {"mdl", "x", "mean", "var", "reduction_axes", "feature_axes",
                  "dtype", "param_dtype", "epsilon", "use_bias", "use_scale",
-                 "bias_init", "scale_init", "force_float32_reductions"}
+                 "bias_init", "scale_init"}
     have_stats = set(inspect.signature(flax_norm._compute_stats).parameters)
     have_norm = set(inspect.signature(flax_norm._normalize).parameters)
     missing = (need_stats - have_stats) | (need_norm - have_norm)
@@ -89,6 +92,14 @@ def _check_flax_private_api() -> None:
             "flax.linen.normalization."
         )
     _FLAX_API_CHECKED = True
+
+
+def _ffr_kwargs(fn, value) -> dict:
+    """``{"force_float32_reductions": value}`` when ``fn`` accepts it,
+    else empty (flax 0.10.x ``_normalize`` promotes dtypes internally)."""
+    if "force_float32_reductions" in inspect.signature(fn).parameters:
+        return {"force_float32_reductions": value}
+    return {}
 
 
 @contextlib.contextmanager
@@ -159,7 +170,9 @@ class BatchNorm(nn.BatchNorm):
             axes=reduction_axes,
             dtype=self.dtype,
             use_fast_variance=self.use_fast_variance,
-            force_float32_reductions=self.force_float32_reductions,
+            **_ffr_kwargs(
+                flax_norm._compute_stats, self.force_float32_reductions
+            ),
         )  # [G, C] each
 
         stats_dtype = (
@@ -191,6 +204,6 @@ class BatchNorm(nn.BatchNorm):
             use_scale=self.use_scale,
             bias_init=self.bias_init,
             scale_init=self.scale_init,
-            force_float32_reductions=self.force_float32_reductions,
+            **_ffr_kwargs(flax_norm._normalize, self.force_float32_reductions),
         )
         return y.reshape(x.shape)

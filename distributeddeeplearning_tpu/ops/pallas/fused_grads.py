@@ -71,18 +71,30 @@ def gspmd_active() -> bool:
     return _GSPMD_TRACE.get()
 
 
+# f32 accumulator budget: half of VMEM, leaving room for the
+# double-buffered input blocks. _fits_vmem is the ENFORCED gate
+# (ADVICE r5): when no tile fits, matmul_dw_db falls back to the stock
+# two-pass XLA path instead of shipping an overflowing kernel.
+_VMEM_ACC_BYTES = 8 * 2**20
+
+
 def _pick_bm(m: int, k: int) -> int:
     """Largest lane-aligned divisor of ``m`` keeping the f32 accumulator
-    ``[k, bm]`` ≤ 8 MiB (half of VMEM, leaving room for double-buffered
-    input blocks). m is a multiple of 128 for every model dim in the
-    zoo; fall back to m itself if not."""
+    ``[k, bm]`` within :data:`_VMEM_ACC_BYTES`. m is a multiple of 128
+    for every model dim in the zoo; fall back to m itself if not (the
+    caller's :func:`_fits_vmem` check decides whether that tile — or a
+    huge-K 128-wide tile — actually fits)."""
     if m % 128:
         return m
-    budget = max(128, min(1024, (8 * 2**20 // 4) // max(k, 1) // 128 * 128))
+    budget = max(128, min(1024, (_VMEM_ACC_BYTES // 4) // max(k, 1) // 128 * 128))
     for bm in range(min(budget, m), 0, -128):
         if m % bm == 0:
             return bm
     return m
+
+
+def _fits_vmem(k: int, bm: int) -> bool:
+    return k * bm * 4 <= _VMEM_ACC_BYTES
 
 
 def _dw_db_kernel(x_ref, g_ref, dw_ref, db_ref, dw_acc, db_acc, *, n: int,
@@ -126,12 +138,22 @@ def matmul_dw_db(x2d: jnp.ndarray, g2d: jnp.ndarray, *, interpret: bool = False)
     n, k = x2d.shape
     n2, m = g2d.shape
     assert n == n2, (x2d.shape, g2d.shape)
+    bm = _pick_bm(m, k)
+    if not _fits_vmem(k, bm):
+        # No lane-aligned tile keeps the accumulator in VMEM (huge K, or
+        # a wide un-128-aligned head): stock XLA two-pass path. Correct
+        # everywhere, just without the single-read-of-g saving.
+        dw = lax.dot_general(
+            x2d, g2d, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db = jnp.sum(g2d.astype(jnp.float32), axis=0)
+        return dw, db
     # Smaller row blocks for wide-K layers: the x block [bn, K] must
     # double-buffer alongside the [K, bm] accumulator.
     bn = 256 if k > 2048 else 512
     if n < bn:
         bn = max(8, (n + 7) // 8 * 8)
-    bm = _pick_bm(m, k)
     num_n = (n + bn - 1) // bn
     num_m = m // bm
     kernel = functools.partial(_dw_db_kernel, n=n, bn=bn)

@@ -242,9 +242,12 @@ def wait_for_multislice(
             consecutive_errors = 0
             out = r.stdout.strip().upper()
             sink.write(f"queued-resource state: {out or '?'}\n")
-            if "ACTIVE" in out:
+            # Exact state comparison (ADVICE r5): substring matching
+            # misclassifies multi-line output or future states that
+            # merely contain these tokens (e.g. detail text).
+            if out == "ACTIVE":
                 return 0
-            if "FAILED" in out or "SUSPENDED" in out:
+            if out in {"FAILED", "SUSPENDED", "SUSPENDING"}:
                 sink.write(f"ERROR: queued resource entered {out}\n")
                 return 1
         if time.monotonic() >= deadline:

@@ -90,6 +90,39 @@ def allreduce_metrics(metrics: PyTree, axis_name: str = "data") -> PyTree:
     return lax.pmean(metrics, axis_name)
 
 
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_keepgrad(x: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """``lax.psum`` whose transpose is the mathematically correct
+    broadcast, on every jax version.
+
+    The PP schedule's loss terms are masked-then-psum'd scalars
+    (``pp_step.py``): ``L = psum(where(owner, local, 0))``. The correct
+    cotangent of that psum w.r.t. the local value is the broadcast
+    ``g`` — which is what the current vma system produces. Older jax
+    transposes psum to psum (the historic wart), silently scaling the
+    cotangent by the axis size and corrupting every gradient that flows
+    through an in-loss psum. This wrapper pins the broadcast transpose
+    explicitly so the schedule differentiates identically everywhere
+    (the ``pcast`` in the bwd keeps the cotangent's varying type honest
+    under ``check_vma``; it is an identity where no vma system exists).
+    """
+    return lax.psum(x, axis_name)
+
+
+def _psum_keepgrad_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _psum_keepgrad_bwd(axis_name, _, g):
+    return (lax.pcast(g, axis_name, to="varying"),)
+
+
+psum_keepgrad.defvjp(_psum_keepgrad_fwd, _psum_keepgrad_bwd)
+
+
 def allreduce_sum(x: PyTree, axis_name: str = "data") -> PyTree:
     return lax.psum(x, axis_name)
 

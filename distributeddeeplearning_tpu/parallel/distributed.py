@@ -56,6 +56,16 @@ def maybe_initialize(
     platform = os.environ.get("DDL_PLATFORM")
     if platform:
         jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        # Multi-process CPU worlds need the gloo collectives layer;
+        # current jax wires it by default, older jaxlib only behind this
+        # flag (without it every cross-process computation fails with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend"). Must be set before the backend initialises.
+        try:
+            jax.config.update("jax_cpu_enable_gloo_collectives", True)
+        except Exception:  # flag retired once gloo became the default
+            pass
 
     explicit = coordinator_address is not None
     autodetect = (

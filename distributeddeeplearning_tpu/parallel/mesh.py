@@ -116,12 +116,19 @@ def mesh_from_config(config) -> Mesh:
             num_slices = config.mesh_shape[0]
             inner_shape = config.mesh_shape[1:]
         else:
-            # No MESH_SHAPE: default to 2 slices when the device count
-            # splits, else degrade to a single slice — a (1, N) mesh,
-            # the pre-round-5 axes-only behaviour — so odd/single-device
-            # boxes keep working.
-            n = len(jax.devices())
-            if inner_axes:
+            # No MESH_SHAPE: on real multi-slice hardware the devices
+            # KNOW their slice (Device.slice_index) — use that count, so
+            # the documented `submit --env MESH_AXES=replica,data` flow
+            # works on any slice count (ADVICE r5: the old hardcoded 2
+            # crashed every pod with != 2 slices). The even-split-to-2
+            # heuristic remains only for virtual devices (CPU tests)
+            # which expose no slice_index.
+            devs = jax.devices()
+            n = len(devs)
+            slice_ids = {getattr(d, "slice_index", None) for d in devs}
+            if inner_axes and None not in slice_ids:
+                num_slices = len(slice_ids)
+            elif inner_axes:
                 num_slices = 2 if n % 2 == 0 else 1
             else:
                 num_slices = n

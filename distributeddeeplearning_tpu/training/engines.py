@@ -56,6 +56,16 @@ class Engine:
     # the default ``batch_sharding(mesh)`` (leading-axis over data).
     batch_sharding: Optional[Callable] = None
 
+    def warmup(self, batch, *, acc=None, eval_batch=None):
+        """AOT-compile the steps against ``batch``'s signature before
+        any data flows: logs compile seconds + XLA cost-analysis FLOPs,
+        installs the executables so the first real step doesn't compile
+        again, and (with a persistent compilation cache enabled) reports
+        the cache hit/miss delta. See ``training/warmup.py``."""
+        from distributeddeeplearning_tpu.training.warmup import warmup_engine
+
+        return warmup_engine(self, batch, acc=acc, eval_batch=eval_batch)
+
 
 def _seq_len_from(input_shape, model) -> Optional[int]:
     if input_shape is not None and len(input_shape) == 2:

@@ -11,6 +11,7 @@ callbacks, checkpointing, and the canonical throughput summary.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
 
 import jax
@@ -26,8 +27,13 @@ from distributeddeeplearning_tpu.training.callbacks import (
     LoggerCallback,
 )
 from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+from distributeddeeplearning_tpu.training.metrics import (
+    finalize_accumulator,
+    init_accumulator,
+)
 from distributeddeeplearning_tpu.training.optimizer import create_optimizer
 from distributeddeeplearning_tpu.training.state import TrainState
+from distributeddeeplearning_tpu.utils import hostsync
 from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
 from distributeddeeplearning_tpu.utils.timer import Timer
 
@@ -47,6 +53,10 @@ class FitResult:
     state: TrainState
     history: List[Dict[str, float]]
     images_per_sec: float
+    # Host-sync accounting for the run (utils/hostsync.py): step-dispatch
+    # p50/p99, wait time, host_sync_count, plus warmup compile_sec when
+    # AOT warmup ran. Informational — never load-bearing for training.
+    perf: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 def resolve_engine(config, mesh=None):
@@ -154,6 +164,14 @@ def fit(
     averaged, Keras ``:344-353``), and prints the ``_log_summary`` block.
     """
     log = get_logger()
+    if config.compilation_cache_dir:
+        # Before any compile (engine init included): re-runs of the same
+        # program deserialize executables instead of re-invoking XLA.
+        from distributeddeeplearning_tpu.training.warmup import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(config.compilation_cache_dir)
     engine_name, mesh = resolve_engine(config, mesh)
     epochs = epochs if epochs is not None else config.epochs
     steps_per_epoch = train_data.steps_per_epoch
@@ -221,6 +239,14 @@ def fit(
 
     train_step = eng.train_step
     eval_step = eng.eval_step if eval_data is not None else None
+    # All engine-built steps carry the metric-accumulator contract
+    # (training/metrics.StepFn); a hand-rolled step without it keeps the
+    # legacy last-step-metrics epoch summary.
+    accumulates = getattr(train_step, "accumulates_metrics", False)
+    clock = hostsync.StepClock()
+    sync_start = hostsync.accountant().count
+    warmup_pending = config.aot_warmup
+    warmup_info: Dict[str, float] = {}
 
     history: List[Dict[str, float]] = []
     # Throughput accounting counts what the dataset actually delivers
@@ -235,26 +261,55 @@ def fit(
     for epoch in range(start_epoch, epochs):
         callback_list.on_epoch_begin(epoch)
         step_in_epoch = 0
+        # Fresh on-device accumulator per epoch: metric sums + step count
+        # ride the compiled step (donated), so epoch statistics build up
+        # in HBM and the loop stays sync-free between epoch boundaries.
+        acc = init_accumulator(mesh) if accumulates else None
         for batch in prefetch_to_device(
             train_data.epoch(epoch), mesh, size=config.prefetch_batches,
             sharding=eng.batch_sharding,
         ):
             global_batch = int(jax.tree.leaves(batch)[0].shape[0])
-            state, metrics = train_step(state, batch)
+            if warmup_pending:
+                # AOT-compile against the real staged signature, OUTSIDE
+                # the dispatch clock — compile time is reported as
+                # compile_sec, not smeared into step time.
+                warmup_info = eng.warmup(batch, acc=acc)
+                warmup_pending = False
+            t0 = time.perf_counter()
+            if accumulates:
+                state, metrics, acc = train_step(state, batch, acc)
+            else:
+                state, metrics = train_step(state, batch)
+            clock.note_dispatch(time.perf_counter() - t0)
             step_in_epoch += 1
             if (
                 config.log_every_steps
                 and step_in_epoch % config.log_every_steps == 0
             ):
+                # Metrics/accumulator stay device-resident on purpose: a
+                # callback that float()s them pays (and owns) that sync.
                 callback_list.on_step_end(
-                    step_in_epoch, {"metrics": metrics, "state": state}
+                    step_in_epoch,
+                    {
+                        "metrics": metrics,
+                        "state": state,
+                        "metric_accumulator": acc,
+                    },
                 )
         epoch_images = step_in_epoch * global_batch
         total_images += epoch_images
-        # One host sync per epoch: materialise the last step's metrics.
-        epoch_logs: Dict[str, Any] = {
-            k: float(jax.device_get(v)) for k, v in metrics.items()
-        }
+        # THE one host sync per epoch: materialise the on-device epoch
+        # means (or, for a legacy step without the accumulator contract,
+        # the last step's metrics) in a single device_get.
+        epoch_values = finalize_accumulator(acc) if accumulates else metrics
+        with clock.waiting():
+            epoch_logs: Dict[str, Any] = {
+                k: float(v)
+                for k, v in hostsync.device_get(
+                    epoch_values, label="epoch_metrics"
+                ).items()
+            }
         epoch_logs["epoch_images"] = epoch_images
 
         if eval_step is not None and eval_data is not None and config.validation:
@@ -275,14 +330,32 @@ def fit(
     if ckpt is not None:
         ckpt.wait()
 
+    perf = clock.summary()
+    perf["host_sync_count"] = float(
+        hostsync.accountant().count - sync_start
+    )
+    perf.update(warmup_info)
+    extra: Dict[str, Any] = {
+        "host_sync_count": int(perf["host_sync_count"]),
+        "dispatch_p50_ms": round(perf["dispatch_p50_ms"], 3),
+        "dispatch_p99_ms": round(perf["dispatch_p99_ms"], 3),
+    }
+    if "compile_sec" in perf:
+        extra["compile_sec"] = round(perf["compile_sec"], 3)
     images_per_sec = log_summary(
         data_length=total_images,
         duration_s=run_timer.elapsed,
         batch_size_per_device=config.batch_size_per_device,
         num_devices=jax.device_count(),
         dataset_kind="synthetic" if config.fake else "real",
+        extra_fields=extra,
     )
-    return FitResult(state=state, history=history, images_per_sec=images_per_sec)
+    return FitResult(
+        state=state,
+        history=history,
+        images_per_sec=images_per_sec,
+        perf=perf,
+    )
 
 
 def _run_eval(
@@ -297,7 +370,14 @@ def _run_eval(
         eval_data.epoch(0), mesh, size=config.prefetch_batches,
         sharding=sharding,
     ):
-        m = {k: float(jax.device_get(v)) for k, v in eval_step(state, batch).items()}
+        # One materialisation per eval batch (boundary work, not the hot
+        # loop) — a single device_get of the whole metric dict.
+        m = {
+            k: float(v)
+            for k, v in hostsync.device_get(
+                eval_step(state, batch), label="eval_batch"
+            ).items()
+        }
         count = m.pop("count", None)
         if count is None:  # legacy eval step: unweighted batch means
             count = 1.0

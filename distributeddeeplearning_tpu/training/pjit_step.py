@@ -259,7 +259,21 @@ def make_pjit_train_step(
         )
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+    from distributeddeeplearning_tpu.training.metrics import (
+        StepFn,
+        accumulate_metrics,
+    )
+
+    def step_acc(state: TrainState, batch: Batch, acc):
+        new_state, metrics = step(state, batch)
+        return new_state, metrics, accumulate_metrics(acc, metrics)
+
+    # Accumulating variant (see train_step.make_train_step): under GSPMD
+    # the scalar accumulator is replicated by construction; both it and
+    # the state are donated.
+    jit2 = jax.jit(step, donate_argnums=(0,) if donate_state else ())
+    jit3 = jax.jit(step_acc, donate_argnums=(0, 2) if donate_state else (2,))
+    return StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
 
 
 def make_pjit_eval_step(
@@ -306,15 +320,24 @@ def make_pjit_eval_step(
         out["count"] = count
         return out
 
-    jitted = jax.jit(eval_step)
+    from distributeddeeplearning_tpu.training.metrics import StepFn
 
-    def step(state: TrainState, batch):
+    jitted = jax.jit(eval_step)
+    inner = StepFn(lambda state, with_acc: jitted)
+
+    def _normalize(batch):
         if len(batch) == 2:
             images, labels = batch
             weights = jnp.ones(labels.shape[:1], jnp.float32)
             batch = (images, labels, weights)
-        return jitted(state, batch)
+        return batch
 
+    def step(state: TrainState, batch):
+        return inner(state, _normalize(batch))
+
+    step.aot_compile = lambda state, batch: inner.aot_compile(
+        state, _normalize(batch)
+    )
     return step
 
 

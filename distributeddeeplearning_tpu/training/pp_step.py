@@ -226,11 +226,18 @@ def make_pp_train_step(
         }
 
         def loss_fn(params):
+            from distributeddeeplearning_tpu.parallel.collectives import (
+                psum_keepgrad,
+            )
+
             logits = pipeline_logits(params, tokens, True, dropout_rng)
             ce_local = cross_entropy_loss(logits, labels, cfg.label_smoothing)
             # Only the last stage's logits are real; psum over pipe turns
             # the masked scalar into the exact (pipe-invariant) loss.
-            ce = lax.psum(jnp.where(is_last, ce_local, 0.0), PIPE_AXIS)
+            # psum_keepgrad: these psums sit INSIDE the differentiated
+            # region, so their transpose must be the broadcast (see
+            # collectives.psum_keepgrad) on every jax version.
+            ce = psum_keepgrad(jnp.where(is_last, ce_local, 0.0), PIPE_AXIS)
             # L2: stage kernels are per-device (psum = total); embed/head
             # are replicated, so their term is masked to stage 0 before
             # the psum — otherwise each of the S devices would contribute
@@ -239,7 +246,7 @@ def make_pp_train_step(
                 {"embed": params["embed"], "head": params["head"]},
                 cfg.weight_decay,
             )
-            l2 = lax.psum(
+            l2 = psum_keepgrad(
                 jnp.where(s_idx == 0, l2_eh, 0.0)
                 + l2_kernel_penalty(params["stages"], cfg.weight_decay),
                 PIPE_AXIS,
@@ -296,9 +303,30 @@ def make_pp_train_step(
         )
         return new_state, metrics
 
-    def build(state: TrainState):
+    from distributeddeeplearning_tpu.training.metrics import (
+        StepFn,
+        accumulate_metrics,
+    )
+
+    def local_step_acc(state: TrainState, batch: Batch, acc):
+        new_state, metrics = local_step(state, batch)
+        return new_state, metrics, accumulate_metrics(acc, metrics)
+
+    def build(state: TrainState, with_acc: bool = False):
         specs = pp_state_specs(state)
         batch_spec = P(d_axis) if d_axis is not None else P()
+        if with_acc:
+            # Accumulating variant (see train_step.make_train_step): the
+            # replicated scalar accumulator is donated alongside the state.
+            return jax.jit(
+                jax.shard_map(
+                    local_step_acc,
+                    mesh=mesh,
+                    in_specs=(specs, (batch_spec, batch_spec), P()),
+                    out_specs=(specs, P(), P()),
+                ),
+                donate_argnums=(0, 2) if donate_state else (2,),
+            )
         return jax.jit(
             jax.shard_map(
                 local_step,
@@ -311,12 +339,13 @@ def make_pp_train_step(
 
     _cache = {}
 
-    def step(state: TrainState, batch: Batch):
-        key = jax.tree_util.tree_structure(state)
+    def resolve(state: TrainState, with_acc: bool):
+        key = (jax.tree_util.tree_structure(state), with_acc)
         if key not in _cache:
-            _cache[key] = build(state)
-        return _cache[key](state, batch)
+            _cache[key] = build(state, with_acc)
+        return _cache[key]
 
+    step = StepFn(resolve)
     step.build = build  # AOT access (scripts/pp_schedule_bench.py)
     return step
 
@@ -571,9 +600,30 @@ def _make_pp_train_step_1f1b(
         )
         return new_state, metrics
 
-    def build(state: TrainState):
+    from distributeddeeplearning_tpu.training.metrics import (
+        StepFn,
+        accumulate_metrics,
+    )
+
+    def local_step_acc(state: TrainState, batch: Batch, acc):
+        new_state, metrics = local_step(state, batch)
+        return new_state, metrics, accumulate_metrics(acc, metrics)
+
+    def build(state: TrainState, with_acc: bool = False):
         specs = pp_state_specs(state)
         batch_spec = P(d_axis) if d_axis is not None else P()
+        if with_acc:
+            # Accumulating variant (see train_step.make_train_step): the
+            # replicated scalar accumulator is donated alongside the state.
+            return jax.jit(
+                jax.shard_map(
+                    local_step_acc,
+                    mesh=mesh,
+                    in_specs=(specs, (batch_spec, batch_spec), P()),
+                    out_specs=(specs, P(), P()),
+                ),
+                donate_argnums=(0, 2) if donate_state else (2,),
+            )
         return jax.jit(
             jax.shard_map(
                 local_step,
@@ -586,12 +636,13 @@ def _make_pp_train_step_1f1b(
 
     _cache = {}
 
-    def step(state: TrainState, batch: Batch):
-        key = jax.tree_util.tree_structure(state)
+    def resolve(state: TrainState, with_acc: bool):
+        key = (jax.tree_util.tree_structure(state), with_acc)
         if key not in _cache:
-            _cache[key] = build(state)
-        return _cache[key](state, batch)
+            _cache[key] = build(state, with_acc)
+        return _cache[key]
 
+    step = StepFn(resolve)
     step.build = build  # AOT access (scripts/pp_schedule_bench.py)
     return step
 
@@ -636,6 +687,8 @@ def make_pp_eval_step(
         out["count"] = count
         return out
 
+    from distributeddeeplearning_tpu.training.metrics import StepFn
+
     def build(state: TrainState):
         specs = pp_state_specs(state)
         batch_spec = P(d_axis) if d_axis is not None else P()
@@ -650,14 +703,25 @@ def make_pp_eval_step(
 
     _cache = {}
 
-    def step(state: TrainState, batch):
+    def resolve(state: TrainState, with_acc: bool):
+        key = jax.tree_util.tree_structure(state)
+        if key not in _cache:
+            _cache[key] = build(state)
+        return _cache[key]
+
+    inner = StepFn(resolve)
+
+    def _normalize(batch):
         if len(batch) == 2:
             tokens, labels = batch
             weights = jnp.ones(labels.shape[:1], jnp.float32)
             batch = (tokens, labels, weights)
-        key = jax.tree_util.tree_structure(state)
-        if key not in _cache:
-            _cache[key] = build(state)
-        return _cache[key](state, batch)
+        return batch
 
+    def step(state: TrainState, batch):
+        return inner(state, _normalize(batch))
+
+    step.aot_compile = lambda state, batch: inner.aot_compile(
+        state, _normalize(batch)
+    )
     return step

@@ -135,6 +135,15 @@ def make_sp_train_step(
             metrics,
         )
 
+    from distributeddeeplearning_tpu.training.metrics import (
+        StepFn,
+        accumulate_metrics,
+    )
+
+    def local_step_acc(state: TrainState, batch: Batch, acc):
+        new_state, metrics = local_step(state, batch)
+        return new_state, metrics, accumulate_metrics(acc, metrics)
+
     spec = P(data_axis, seq_axis)
     sharded = jax.shard_map(
         local_step,
@@ -142,7 +151,19 @@ def make_sp_train_step(
         in_specs=(P(), (spec, spec)),
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    # Accumulating variant (see train_step.make_train_step): donated
+    # replicated accumulator, epoch means computed on device.
+    sharded_acc = jax.shard_map(
+        local_step_acc,
+        mesh=mesh,
+        in_specs=(P(), (spec, spec), P()),
+        out_specs=(P(), P(), P()),
+    )
+    jit2 = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    jit3 = jax.jit(
+        sharded_acc, donate_argnums=(0, 2) if donate_state else (2,)
+    )
+    return StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
 
 
 def make_sp_eval_step(
@@ -180,6 +201,8 @@ def make_sp_eval_step(
         out["count"] = count
         return out
 
+    from distributeddeeplearning_tpu.training.metrics import StepFn
+
     spec = P(data_axis, seq_axis)
     sharded = jax.jit(
         jax.shard_map(
@@ -189,8 +212,9 @@ def make_sp_eval_step(
             out_specs=P(),
         )
     )
+    inner = StepFn(lambda state, with_acc: sharded)
 
-    def step(state: TrainState, batch):
+    def _normalize(batch):
         if len(batch) == 2:
             # Convenience (single-host tests): all samples real — same
             # contract as train_step.make_eval_step.
@@ -202,6 +226,12 @@ def make_sp_eval_step(
             tokens, labels = batch
             weights = jnp.ones(labels.shape[:1], jnp.float32)
             batch = (tokens, labels, weights)
-        return sharded(state, batch)
+        return batch
 
+    def step(state: TrainState, batch):
+        return inner(state, _normalize(batch))
+
+    step.aot_compile = lambda state, batch: inner.aot_compile(
+        state, _normalize(batch)
+    )
     return step

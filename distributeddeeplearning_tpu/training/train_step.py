@@ -235,9 +235,13 @@ def make_train_step(
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the compiled DP train step over ``mesh``.
 
-    Returns ``step(state, (images, labels)) -> (state, metrics)`` where
-    ``state`` is replicated and the batch is sharded on its leading axis
-    over the mesh's batch axes. Metrics are already cross-replica means.
+    Returns a :class:`~.metrics.StepFn`:
+    ``step(state, (images, labels)) -> (state, metrics)`` — ``state``
+    replicated, batch sharded on its leading axis over the mesh's batch
+    axes, metrics already cross-replica means — and
+    ``step(state, batch, acc) -> (state, metrics, new_acc)``, the
+    accumulating variant the training loop runs (metric sums build up
+    on device; ``acc`` is donated).
 
     ``check_vma=None`` auto-resolves: on except for interpreter-mode
     Pallas attention (see :func:`_pallas_interpreted`).
@@ -333,6 +337,15 @@ def make_train_step(
         )
         return new_state, metrics
 
+    from distributeddeeplearning_tpu.training.metrics import (
+        StepFn,
+        accumulate_metrics,
+    )
+
+    def local_step_acc(state: TrainState, batch: Batch, acc):
+        new_state, metrics = local_step(state, batch)
+        return new_state, metrics, accumulate_metrics(acc, metrics)
+
     batch_spec = P(axis if isinstance(axis, str) else tuple(axes))
     sharded = jax.shard_map(
         local_step,
@@ -341,7 +354,22 @@ def make_train_step(
         out_specs=(P(), P()),
         check_vma=check_vma,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    # Accumulating variant (loop.fit's hot path): the donated replicated
+    # accumulator rides the same compiled program — epoch statistics
+    # build up on device, no mid-epoch host sync. Lazily compiled: only
+    # the arity a caller actually uses pays its compile.
+    sharded_acc = jax.shard_map(
+        local_step_acc,
+        mesh=mesh,
+        in_specs=(P(), (batch_spec, batch_spec), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=check_vma,
+    )
+    jit2 = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    jit3 = jax.jit(
+        sharded_acc, donate_argnums=(0, 2) if donate_state else (2,)
+    )
+    return StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
 
 
 def eval_metrics_fn(
@@ -424,6 +452,8 @@ def make_eval_step(
         out["count"] = count
         return out
 
+    from distributeddeeplearning_tpu.training.metrics import StepFn
+
     batch_spec = P(axis if isinstance(axis, str) else tuple(axes))
     sharded = jax.jit(
         jax.shard_map(
@@ -434,8 +464,9 @@ def make_eval_step(
             check_vma=check_vma,
         )
     )
+    inner = StepFn(lambda state, with_acc: sharded)
 
-    def step(state: TrainState, batch):
+    def _normalize(batch):
         if len(batch) == 2:
             # Convenience (single-host tests): all samples real.
             if jax.process_count() > 1:
@@ -446,8 +477,14 @@ def make_eval_step(
             images, labels = batch
             weights = jnp.ones(labels.shape[:1], jnp.float32)
             batch = (images, labels, weights)
-        return sharded(state, batch)
+        return batch
 
+    def step(state: TrainState, batch):
+        return inner(state, _normalize(batch))
+
+    step.aot_compile = lambda state, batch: inner.aot_compile(
+        state, _normalize(batch)
+    )
     return step
 
 

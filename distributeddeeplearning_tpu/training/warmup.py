@@ -1,0 +1,169 @@
+"""Persistent compilation cache + AOT step warmup.
+
+Every process used to pay full XLA compile time on every run: nothing
+wired ``jax_compilation_cache_dir``, and the first training step ate the
+compile inside the (timed) hot loop. This module is the cheap-restart
+story:
+
+* :func:`enable_persistent_cache` turns on JAX's on-disk compilation
+  cache (config knob ``TrainConfig.compilation_cache_dir`` / env
+  ``COMPILATION_CACHE_DIR``): re-runs of ``bench.py``,
+  ``scripts/recertify.py`` and multi-epoch jobs deserialize the
+  executable instead of recompiling. Thresholds default to
+  "cache everything" — on the CPU test tier compiles are fast but still
+  dominate tiny runs, and on TPU a serialized executable is always
+  cheaper than XLA.
+* :func:`cache_stats` observes the cache's hit/miss monitoring events so
+  a warm-start can be *proved* (the round's oracle asserts hits > 0 on a
+  second warmup against a warm cache) instead of inferred from wall
+  clock.
+* :func:`warmup_engine` — backing for ``Engine.warmup()`` — AOT-compiles
+  the train (and optionally eval) step before any data flows, logs
+  compile seconds and XLA cost-analysis FLOPs, and installs the
+  executables on the :class:`~.metrics.StepFn` so the loop's first step
+  does not compile again.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from distributeddeeplearning_tpu.utils.logging import get_logger
+
+_stats = {"hits": 0, "misses": 0}
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _on_event(event: str, **kw) -> None:
+    if event.endswith("/cache_hits"):
+        _stats["hits"] += 1
+    elif event.endswith("/cache_misses"):
+        _stats["misses"] += 1
+
+
+def install_cache_listener() -> bool:
+    """Subscribe to the compilation-cache monitoring events (idempotent).
+    Returns False when this jax build exposes no monitoring hook."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return True
+        try:
+            from jax._src import monitoring
+        except ImportError:  # pragma: no cover - jax internals moved
+            return False
+        monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+        return True
+
+
+def cache_stats() -> Tuple[int, int]:
+    """(persistent-cache hits, misses) observed so far this process."""
+    return _stats["hits"], _stats["misses"]
+
+
+def enable_persistent_cache(
+    cache_dir: Optional[str],
+    *,
+    min_compile_secs: float = 0.0,
+    min_entry_bytes: int = 0,
+) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    ``None``/empty disables it again. The thresholds are deliberately
+    zero: JAX's defaults skip sub-second compiles, which is exactly the
+    CPU-tier regime where the cache oracle must be able to observe hits.
+    """
+    if not cache_dir:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_cache_state()
+        return
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_secs)
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", int(min_entry_bytes)
+    )
+    # jax latches "cache disabled" at the first compile of the process;
+    # enabling later (typical: fit() after library imports already
+    # compiled something) needs the latch cleared to take effect.
+    _reset_cache_state()
+    install_cache_listener()
+
+
+def _reset_cache_state() -> None:
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+
+
+def cost_analysis_flops(compiled: Any) -> Optional[float]:
+    """FLOPs per execution from XLA's cost analysis (None if the backend
+    does not report them — cost analysis is advisory, never load-bearing)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if isinstance(ca, dict):
+        flops = ca.get("flops", 0.0)
+        return float(flops) if flops else None
+    return None
+
+
+def warmup_engine(
+    eng,
+    batch: Any,
+    *,
+    acc: Any = None,
+    eval_batch: Any = None,
+) -> Dict[str, float]:
+    """AOT-compile ``eng``'s steps against ``batch``'s signature.
+
+    ``batch`` is a staged (device-resident) batch or a matching tree of
+    ``jax.ShapeDtypeStruct``; ``acc`` non-None warms the accumulating
+    train-step variant (what ``loop.fit`` runs). Returns compile seconds,
+    cost-analysis FLOPs, and the persistent-cache hit/miss delta, and
+    logs a one-line summary.
+    """
+    log = get_logger()
+    install_cache_listener()
+    hits0, misses0 = cache_stats()
+    info: Dict[str, float] = {}
+
+    step = eng.train_step
+    if hasattr(step, "aot_compile"):
+        compiled, secs = step.aot_compile(eng.state, batch, acc)
+        info["train_compile_sec"] = secs
+        flops = cost_analysis_flops(compiled)
+        if flops is not None:
+            info["train_flops_per_step"] = flops
+    if eval_batch is not None and hasattr(eng.eval_step, "aot_compile"):
+        _, secs = eng.eval_step.aot_compile(eng.state, eval_batch)
+        info["eval_compile_sec"] = secs
+
+    hits1, misses1 = cache_stats()
+    info["persistent_cache_hits"] = float(hits1 - hits0)
+    info["persistent_cache_misses"] = float(misses1 - misses0)
+    info["compile_sec"] = info.get("train_compile_sec", 0.0) + info.get(
+        "eval_compile_sec", 0.0
+    )
+    flops = info.get("train_flops_per_step")
+    log.info(
+        "warmup(%s): compiled in %.2fs%s (persistent cache: %d hit, %d miss)",
+        eng.name,
+        info["compile_sec"],
+        f", {flops / 1e9:.2f} GFLOP/step" if flops else "",
+        hits1 - hits0,
+        misses1 - misses0,
+    )
+    return info

@@ -1,0 +1,136 @@
+"""Forward-compat shims: run the repo's JAX idioms on an older jaxlib.
+
+The codebase is written against the current JAX API surface —
+``jax.shard_map`` with ``check_vma``, ``lax.pcast`` vma casts,
+``jax.typeof``, ``ShapeDtypeStruct(..., vma=...)``,
+``pallas.tpu.CompilerParams``. A pinned container toolchain can lag
+(jax 0.4.x exposes shard_map only as ``jax.experimental.shard_map`` with
+``check_rep``, and has no vma type system at all). :func:`install`
+backfills the missing attributes with semantics-preserving adapters so
+ONE source tree runs on both:
+
+* ``jax.shard_map(..., check_vma=...)`` → experimental shard_map with
+  ``check_rep=False``. The vma ("varying across mesh axes") type system
+  does not exist on 0.4.x; with replication tracking off,
+  differentiation inside the mapped body is purely local per device —
+  exactly the semantics the engines' explicit ``pcast`` + ``pmean``
+  pattern assumes (see ``training/train_step.py``), and the engine-
+  equality oracles (`tests/test_train_step.py::test_dp_matches_single_
+  device` et al.) verify the numbers end-to-end.
+* ``lax.pcast(x, axis, to=...)`` → identity. pcast moves values between
+  vma types; with no vma system there is nothing to move and the values
+  are untouched either way.
+* ``jax.typeof`` → ``get_aval``. Callers only probe ``.vma`` on the
+  result (absent → treated as "varies over nothing"), which is the
+  correct degenerate answer here.
+* ``jax.ShapeDtypeStruct`` → subclass accepting-and-dropping ``vma=``.
+* ``pallas.tpu.CompilerParams`` → alias of the old ``TPUCompilerParams``.
+
+Every shim installs ONLY when the attribute is missing — on a current
+jax this module is inert. Called from the package ``__init__`` so any
+entry point (tests, bench, launcher children) gets it before tracing.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+
+# Names install() actually had to backfill (empty on a current jax).
+# Tests use this to skip assertions that only the real API can satisfy
+# (e.g. vma-based sharding checks need a real pcast, not the identity).
+SHIMMED: set = set()
+
+
+def shimmed(name: str) -> bool:
+    return name in SHIMMED
+
+
+def install() -> None:
+    """Idempotently backfill missing jax APIs (no-op on current jax)."""
+    import jax
+
+    if getattr(jax, "_ddl_tpu_compat_installed", False):
+        return
+
+    from jax import lax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+        @functools.wraps(_legacy_shard_map)
+        def shard_map(
+            f,
+            mesh=None,
+            in_specs=None,
+            out_specs=None,
+            *,
+            check_vma=None,
+            check_rep=None,
+            **kwargs,
+        ):
+            # No vma system on this jax: replication tracking off is the
+            # faithful translation (the repo's AD happens inside the
+            # mapped body, with explicit collectives).
+            del check_vma, check_rep
+            return _legacy_shard_map(
+                f,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_rep=False,
+                **kwargs,
+            )
+
+        jax.shard_map = shard_map
+        SHIMMED.add("shard_map")
+
+    if not hasattr(lax, "pcast"):
+
+        def pcast(x, axis_name=None, *, to=None):
+            del axis_name, to  # no vma types to move between
+            return x
+
+        lax.pcast = pcast
+        SHIMMED.add("pcast")
+
+    if not hasattr(jax, "typeof"):
+        from jax._src.core import get_aval
+
+        jax.typeof = get_aval
+        SHIMMED.add("typeof")
+
+    if "vma" not in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters:
+        _SDS = jax.ShapeDtypeStruct
+
+        class ShapeDtypeStruct(_SDS):  # noqa: N801 - drop-in replacement
+            def __init__(self, shape, dtype, *args, vma=None, **kwargs):
+                del vma
+                super().__init__(shape, dtype, *args, **kwargs)
+
+        jax.ShapeDtypeStruct = ShapeDtypeStruct
+        SHIMMED.add("ShapeDtypeStruct.vma")
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+            SHIMMED.add("CompilerParams")
+    except ImportError:  # pallas not built on this platform
+        pass
+
+    # Current jax generates partitionable (layout-invariant) random bits
+    # by default; old jax defaults this OFF, which makes sharded-at-birth
+    # param init and in-step dropout depend on the mesh layout — the
+    # expert-parallel layout-invariance oracle (tests/test_moe.py)
+    # catches exactly that. Pin the modern semantics.
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+            SHIMMED.add("threefry_partitionable")
+    except AttributeError:  # option removed once it became the only mode
+        pass
+
+    jax._ddl_tpu_compat_installed = True

@@ -1,0 +1,152 @@
+"""Host↔device materialisation accounting — the sync-free-loop ledger.
+
+A synchronous data-parallel step is only as fast as its dispatch stays
+asynchronous: one stray ``device_get`` (or ``float(jax_array)``) in the
+hot loop stalls the XLA dispatch queue and serialises host and device.
+The reference had no way to even *see* this class of regression; here it
+is first-class instrumentation:
+
+* :class:`SyncAccountant` — a process-global counter of device→host
+  materialisations, labelled by call site. The training loop routes its
+  single per-epoch materialisation through :func:`device_get`, so the
+  CPU-tier oracle can assert "≤ 1 host sync per epoch" as an invariant
+  rather than a hope (``tests/test_sync_free_loop.py``).
+* :func:`track` — a context manager that additionally patches
+  ``jax.device_get`` itself, catching materialisations from code that
+  does not use this module (callbacks, user code).
+* :class:`StepClock` — per-step dispatch-time and per-epoch wait-time
+  recorder; ``summary()`` reports p50/p99 dispatch and total wait so a
+  perf trace can attribute step time to "host dispatching work" vs
+  "host blocked on the device".
+
+Everything here is host-side bookkeeping: nothing in this module may
+ever add device work to the step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, Iterator, List
+
+
+class SyncAccountant:
+    """Counts device→host materialisations, by label."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.count = 0
+        self.by_label: Dict[str, int] = {}
+
+    def record(self, label: str = "device_get", n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+            self.by_label[label] = self.by_label.get(label, 0) + n
+
+    def reset(self) -> None:
+        with self._lock:
+            self.count = 0
+            self.by_label = {}
+
+
+_GLOBAL = SyncAccountant()
+
+
+def accountant() -> SyncAccountant:
+    """The process-global accountant (tests reset it between runs)."""
+    return _GLOBAL
+
+
+# device_get below resolves jax.device_get by attribute lookup, so
+# inside track() it would hit the patched version and double-count.
+# _DELEGATE is what it actually invokes; track() repoints it to the
+# saved original for the duration of the patch.
+_DELEGATE = None  # None → resolve jax.device_get at call time
+
+
+def _materialise(tree: Any) -> Any:
+    import jax
+
+    fn = _DELEGATE if _DELEGATE is not None else jax.device_get
+    return fn(tree)
+
+
+def device_get(tree: Any, label: str = "device_get") -> Any:
+    """``jax.device_get`` that books the materialisation with the
+    accountant. All repo-internal host syncs go through here — a grep
+    for raw ``jax.device_get`` in a hot path is a review flag."""
+    _GLOBAL.record(label)
+    return _materialise(tree)
+
+
+@contextlib.contextmanager
+def track(label: str = "jax.device_get") -> Iterator[SyncAccountant]:
+    """Count *every* ``jax.device_get`` in the process while active.
+
+    Patches ``jax.device_get`` so materialisations from code outside
+    this module are booked too (the oracle test wraps ``loop.fit`` in
+    this to prove no stray syncs hide in callbacks or staging). Calls
+    through :func:`device_get` are not double-counted — it books
+    directly against the accountant before delegating."""
+    import jax
+
+    original = jax.device_get
+
+    def counted(x):
+        _GLOBAL.record(label)
+        return original(x)
+
+    jax.device_get = counted
+    # Book module-level device_get calls once, not twice: swap in the
+    # saved original for the delegation path.
+    global _DELEGATE
+    _DELEGATE, saved = original, _DELEGATE
+    try:
+        yield _GLOBAL
+    finally:
+        jax.device_get = original
+        _DELEGATE = saved
+
+
+class StepClock:
+    """Dispatch-vs-wait decomposition of the training hot loop.
+
+    ``note_dispatch`` records the host time spent *launching* one step
+    (returns as soon as XLA has enqueued the program — small and flat
+    when the loop is sync-free); ``waiting()`` wraps the deliberate
+    blocking points (the one epoch-boundary materialisation). p99 of the
+    dispatch series is the canary: a host sync inside the loop shows up
+    as a dispatch-time spike the size of a device step."""
+
+    def __init__(self) -> None:
+        self.dispatch_s: List[float] = []
+        self.wait_s: List[float] = []
+
+    def note_dispatch(self, seconds: float) -> None:
+        self.dispatch_s.append(seconds)
+
+    @contextlib.contextmanager
+    def waiting(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.wait_s.append(time.perf_counter() - t0)
+
+    @staticmethod
+    def _percentile(sorted_vals: List[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+        return sorted_vals[idx]
+
+    def summary(self) -> Dict[str, float]:
+        d = sorted(self.dispatch_s)
+        return {
+            "steps": float(len(d)),
+            "dispatch_p50_ms": self._percentile(d, 0.50) * 1e3,
+            "dispatch_p99_ms": self._percentile(d, 0.99) * 1e3,
+            "dispatch_total_s": sum(d),
+            "wait_total_s": sum(self.wait_s),
+        }

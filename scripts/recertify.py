@@ -57,6 +57,11 @@ PROTOCOLS = {
 def run_protocol(name: str, env_over: dict, timeout_s: float) -> dict:
     env = dict(os.environ)
     env.update(env_over)
+    # One persistent compilation cache across the whole battery (and
+    # across re-runs at the same commit): every protocol subprocess
+    # deserializes executables instead of recompiling. Opt out with
+    # COMPILATION_CACHE_DIR="" (bench.py treats empty as off).
+    env.setdefault("COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
     # One fast retry per protocol: distinguishes a transient relay flap
     # from a real regression (bench.py itself retries device init).
     for attempt in (1, 2):
@@ -73,7 +78,15 @@ def run_protocol(name: str, env_over: dict, timeout_s: float) -> dict:
             ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
         ]
         if lines:
-            rec = json.loads(lines[-1])
+            try:
+                rec = json.loads(lines[-1])
+            except json.JSONDecodeError as e:
+                # A killed child can leave a partial line that starts
+                # with '{' — record a failed row, don't abort the battery.
+                rec = {"error": f"unparseable JSON line ({e}); "
+                                f"rc={r.returncode}",
+                       "stdout_tail": r.stdout[-300:]}
+                continue
             rec["wall_s"] = round(time.perf_counter() - t0, 1)
             if rec.get("value", 0) > 0:
                 return rec

@@ -17,19 +17,24 @@ def test_run_bench_smoke(mesh8):
     # knobs are explicit parameters now (main() owns the env parsing)
     import bench
 
-    ips, n_dev = bench.run_bench(2, devices=2, depth=18, image_size=16)
+    ips, n_dev, perf = bench.run_bench(2, devices=2, depth=18, image_size=16)
     assert n_dev == 2
     assert np.isfinite(ips) and ips > 0
+    # sync-free accounting: compile time measured apart from the loop,
+    # and the measured region syncs exactly once (the closing fence).
+    assert perf["compile_sec"] > 0
+    assert perf["host_sync_count"] == 1
 
 
 def test_run_bench_named_model_smoke(mesh8):
     import bench
 
-    ips, n_dev = bench.run_bench(
+    ips, n_dev, perf = bench.run_bench(
         2, devices=2, model_name="vit_ti16", image_size=16
     )
     assert n_dev == 2
     assert np.isfinite(ips) and ips > 0
+    assert perf["host_sync_count"] == 1
 
 
 def test_bench_scaling_emits_efficiency(mesh8, capsys, monkeypatch):
@@ -51,6 +56,9 @@ def test_bench_scaling_emits_efficiency(mesh8, capsys, monkeypatch):
     assert "scaling_efficiency" in detail, detail
     assert 0.0 < detail["scaling_efficiency"] <= 1.5
     assert detail["images_per_sec_1_device"] > 0
+    # perf-trajectory fields ride every bench line (ISSUE 1)
+    assert out["compile_sec"] > 0
+    assert out["host_sync_count"] >= 1
 
 
 def test_bench_decode_mode(mesh8, capsys, monkeypatch):
@@ -69,6 +77,35 @@ def test_bench_decode_mode(mesh8, capsys, monkeypatch):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["metric"] == "lm_tiny_decode_tokens_per_sec"
     assert out["value"] > 0
+
+
+def test_recertify_run_protocol_tolerates_partial_json(monkeypatch):
+    """ADVICE r5: a killed child can leave a partial '{'-prefixed stdout
+    line; the battery must record a failed row, not abort on
+    JSONDecodeError. Also: children inherit a default persistent
+    compilation cache dir (opt out with COMPILATION_CACHE_DIR=\"\")."""
+    import subprocess
+    import types
+
+    from scripts import recertify
+
+    seen_env = {}
+
+    def fake_run(cmd, env=None, timeout=None, capture_output=None, text=None):
+        seen_env.update(env or {})
+        return types.SimpleNamespace(
+            stdout='garbage\n{"metric": "x", "value": 3.0, truncated',
+            stderr="", returncode=1,
+        )
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rec = recertify.run_protocol("resnet50", {"BENCH_BATCH": "1"}, 5.0)
+    assert "unparseable JSON" in rec["error"]
+    assert seen_env["COMPILATION_CACHE_DIR"].endswith(".jax_cache")
+
+    monkeypatch.setenv("COMPILATION_CACHE_DIR", "")  # explicit opt-out
+    recertify.run_protocol("resnet50", {"BENCH_BATCH": "1"}, 5.0)
+    assert seen_env["COMPILATION_CACHE_DIR"] == ""
 
 
 def test_device_init_watchdog():

@@ -156,6 +156,49 @@ def test_mesh_from_config_builds_hybrid(devices):
     assert mesh.shape["replica"] == 2 and mesh.shape["data"] == 4
 
 
+def test_mesh_from_config_defaults_slices_from_hardware(devices, monkeypatch):
+    """ADVICE r5: MESH_AXES=replica,data with NO MESH_SHAPE must follow
+    the hardware slice count (Device.slice_index) — the old hardcoded 2
+    crashed every pod with a different slice count. Virtual (CPU)
+    devices expose no slice_index and keep the even-split heuristic."""
+    import types
+
+    import jax
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.parallel import mesh as mesh_mod
+
+    captured = {}
+
+    def fake_hybrid(num_slices, *, axes=("data",), shape=None, devices=None):
+        captured["num_slices"] = num_slices
+        return "mesh-sentinel"
+
+    monkeypatch.setattr(mesh_mod, "create_hybrid_mesh", fake_hybrid)
+    fakes = [
+        types.SimpleNamespace(slice_index=i // 2, id=i, process_index=0)
+        for i in range(8)  # 4 hardware slices x 2 chips
+    ]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: fakes)
+    cfg = TrainConfig(mesh_axes=("replica", "data"))  # no MESH_SHAPE
+    assert mesh_mod.mesh_from_config(cfg) == "mesh-sentinel"
+    assert captured["num_slices"] == 4
+
+    # CPU fallback: no slice_index anywhere -> even split to 2
+    cpu_fakes = [
+        types.SimpleNamespace(id=i, process_index=0) for i in range(8)
+    ]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: cpu_fakes)
+    mesh_mod.mesh_from_config(cfg)
+    assert captured["num_slices"] == 2
+
+    # an explicit MESH_SHAPE always wins over hardware detection
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: fakes)
+    cfg2 = TrainConfig(mesh_axes=("replica", "data"), mesh_shape=(2, 4))
+    mesh_mod.mesh_from_config(cfg2)
+    assert captured["num_slices"] == 2
+
+
 def test_hierarchical_pmean_matches_flat(devices):
     """Staged in-slice→cross-slice mean == single global mean (mean of
     means over equal groups), on a (replica=2, data=4) hybrid mesh."""

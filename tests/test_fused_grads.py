@@ -44,6 +44,32 @@ def test_matmul_dw_db_matches_xla(n, k, m):
     )
 
 
+def test_vmem_budget_enforced_falls_back_to_xla():
+    """ADVICE r5: when no lane-aligned tile keeps the f32 accumulator
+    [k, bm] inside the VMEM budget (huge K, or a wide un-128-aligned
+    head), matmul_dw_db must take the stock XLA path — correct numbers,
+    no overflowing kernel — instead of clamping bm and shipping it."""
+    from distributeddeeplearning_tpu.ops.pallas import fused_grads as fg
+
+    assert not fg._fits_vmem(20_000, fg._pick_bm(256, 20_000))
+    assert not fg._fits_vmem(20_000, fg._pick_bm(200, 20_000))  # bm=m path
+    assert fg._fits_vmem(128, fg._pick_bm(256, 128))
+
+    rng = np.random.RandomState(3)
+    n, k, m = 8, 20_000, 200
+    x = jnp.asarray(rng.randn(n, k).astype(np.float32), jnp.bfloat16)
+    g = jnp.asarray(rng.randn(n, m).astype(np.float32), jnp.bfloat16)
+    # interpret=False is safe here BECAUSE the fallback is pure XLA; a
+    # pallas_call would need interpret mode on CPU.
+    dw, db = matmul_dw_db(x, g, interpret=False)
+    ref_dw = jax.lax.dot_general(
+        x, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ref_db = jnp.sum(g.astype(jnp.float32), axis=0)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(ref_dw), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(ref_db), rtol=1e-5)
+
+
 def test_bias_dense_forward_matches_dense():
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(4, 17, 128).astype(np.float32))

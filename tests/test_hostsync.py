@@ -1,0 +1,57 @@
+"""Unit tests for the host-sync accounting layer (utils/hostsync.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.utils import hostsync
+
+
+def test_accountant_counts_and_labels():
+    acct = hostsync.accountant()
+    acct.reset()
+    x = jnp.arange(4.0)
+    y = hostsync.device_get(x, label="alpha")
+    hostsync.device_get(x, label="alpha")
+    hostsync.device_get({"a": x, "b": x}, label="beta")  # one tree = one sync
+    np.testing.assert_array_equal(y, np.arange(4.0))
+    assert acct.count == 3
+    assert acct.by_label == {"alpha": 2, "beta": 1}
+    acct.reset()
+    assert acct.count == 0 and acct.by_label == {}
+
+
+def test_track_counts_raw_device_get_without_double_counting():
+    acct = hostsync.accountant()
+    acct.reset()
+    x = jnp.ones((2,))
+    with hostsync.track() as tracked:
+        jax.device_get(x)  # raw call: counted by the patch
+        hostsync.device_get(x, label="wrapped")  # counted ONCE, not twice
+    assert tracked is acct
+    assert acct.count == 2, acct.by_label
+    assert acct.by_label["jax.device_get"] == 1
+    assert acct.by_label["wrapped"] == 1
+    # patch removed on exit
+    before = acct.count
+    jax.device_get(x)
+    assert acct.count == before
+
+
+def test_step_clock_percentiles_and_wait():
+    clock = hostsync.StepClock()
+    for ms in (1, 2, 3, 4, 100):
+        clock.note_dispatch(ms / 1e3)
+    with clock.waiting():
+        pass
+    s = clock.summary()
+    assert s["steps"] == 5
+    assert s["dispatch_p50_ms"] == 3.0
+    assert s["dispatch_p99_ms"] == 100.0
+    assert s["wait_total_s"] >= 0.0
+    assert abs(s["dispatch_total_s"] - 0.110) < 1e-9
+
+
+def test_step_clock_empty_summary():
+    s = hostsync.StepClock().summary()
+    assert s["steps"] == 0 and s["dispatch_p99_ms"] == 0.0
