@@ -22,7 +22,7 @@ JOB               ?= ddl-train
 PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke \
-        notebooks bench recertify decode-audit \
+        notebooks bench recertify decode-audit heavy-refresh obs-report \
         native provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -77,6 +77,15 @@ recertify:	## all headline protocols at one HEAD -> RECERT.json (round 5)
 
 decode-audit:	## decode-tier roofline + batch sweep (round 5)
 	$(PY) scripts/decode_audit.py
+
+heavy-refresh:	## prune tests/heavy_tests.txt against --collect-only + print tier numbers
+	$(PY) scripts/heavy_refresh.py
+
+# Render the observability report for the most recent run directory
+# (OBS_RUN=dir overrides; runs land under runs/ by convention — the
+# launcher's --obs-dir, bench --events, or OBS_DIR on any entry point).
+obs-report:	## event-bus run report for the newest runs/<dir> (docs/OBSERVABILITY.md)
+	$(PY) scripts/obs_report.py $(or $(OBS_RUN),$(shell ls -td runs/*/ 2>/dev/null | head -1))
 
 ## Native IO tier (built on demand by the Python bindings too)
 native:

@@ -16,6 +16,13 @@ measured apart from the hot loop — set ``COMPILATION_CACHE_DIR`` to make
 re-runs deserialize instead of recompiling) and ``host_sync_count`` (host
 materialisations inside the measured region; exactly 1 — the closing
 fence — when the loop is sync-free).
+
+``--events`` (or ``OBS_DIR`` in the env) additionally routes every
+record and the compile/measure spans through the structured event bus
+(``distributeddeeplearning_tpu/obs/``): the one JSON line on stdout
+stays the driver protocol, but the same record lands in the run's
+``events-p0.jsonl`` where ``scripts/obs_report.py`` can merge it with
+training-loop and launcher events.
 """
 
 from __future__ import annotations
@@ -31,6 +38,19 @@ import numpy as np
 REFERENCE_IMAGES_PER_SEC_PER_DEVICE = 325.0  # V100 fp32 ResNet50, reference stack
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
+
+
+def _emit_record(record: dict) -> None:
+    """THE output path for every protocol record: the canonical JSON
+    line on stdout (the driver's contract, unchanged) plus the same
+    record as a ``bench_result`` event on the bus — ring-only when
+    events mode is off, persisted when ``--events``/``OBS_DIR`` is on."""
+    print(json.dumps(record), flush=True)
+    from distributeddeeplearning_tpu import obs
+
+    bus = obs.get_bus()
+    bus.point("bench_result", **record)
+    bus.flush()
 
 
 def run_bench(
@@ -93,7 +113,10 @@ def run_bench(
     # AOT compile, separately timed: compile cost must never smear into
     # the measured region, and with a persistent compilation cache
     # (COMPILATION_CACHE_DIR) re-runs deserialize instead of recompiling.
-    _, compile_sec = step.aot_compile(state, batch)
+    from distributeddeeplearning_tpu import obs
+
+    with obs.span("compile", what="bench_step"):
+        _, compile_sec = step.aot_compile(state, batch)
 
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
@@ -111,7 +134,7 @@ def run_bench(
         else contextlib.nullcontext()
     )
     sync0 = hostsync.accountant().count
-    with prof:
+    with prof, obs.span("bench_measure", steps=MEASURE_STEPS):
         t0 = time.perf_counter()
         for _ in range(MEASURE_STEPS):
             state, metrics = step(state, batch)
@@ -181,7 +204,10 @@ def run_lm_bench(
     rows = rng.randint(0, vocab, size=(global_batch, seq_len + 1)).astype(np.int32)
     batch = shard_batch((rows[:, :-1], rows[:, 1:]), mesh)
 
-    _, compile_sec = step.aot_compile(state, batch)  # see run_bench
+    from distributeddeeplearning_tpu import obs
+
+    with obs.span("compile", what="bench_step"):
+        _, compile_sec = step.aot_compile(state, batch)  # see run_bench
 
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
@@ -192,7 +218,7 @@ def run_lm_bench(
         jax.profiler.trace(profile_dir) if profile_dir else contextlib.nullcontext()
     )
     sync0 = hostsync.accountant().count
-    with prof:
+    with prof, obs.span("bench_measure", steps=MEASURE_STEPS):
         t0 = time.perf_counter()
         for _ in range(MEASURE_STEPS):
             state, metrics = step(state, batch)
@@ -252,7 +278,7 @@ def decode_main():
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
     try:
         tps = run_decode_bench(model_name, batch, prompt_len, new_tokens)
-        print(json.dumps({
+        _emit_record({
             "metric": f"{model_name}_decode_tokens_per_sec",
             "value": round(tps, 1),
             "unit": "tokens/sec",
@@ -262,13 +288,13 @@ def decode_main():
                 "new_tokens": new_tokens,
                 "platform": jax.devices()[0].platform,
             },
-        }))
+        })
         return 0
     except Exception as e:
-        print(json.dumps({
+        _emit_record({
             "metric": f"{model_name}_decode_tokens_per_sec", "value": 0.0,
             "unit": "tokens/sec", "vs_baseline": 0.0, "error": repr(e),
-        }))
+        })
         return 1
 
 
@@ -290,41 +316,37 @@ def lm_main():
             tps, n_dev, perf = run_lm_bench(
                 model_name, per_device_batch, seq_len, attn_impl, profile_dir
             )
-            print(
-                json.dumps(
-                    {
-                        "metric": f"{model_name}_synthetic_train_tokens_per_sec",
-                        "value": round(tps, 1),
-                        # no reference point: the reference is vision-only
-                        "unit": "tokens/sec",
-                        "vs_baseline": 0.0,
-                        "compile_sec": perf["compile_sec"],
-                        "host_sync_count": perf["host_sync_count"],
-                        "detail": {
-                            "devices": n_dev,
-                            "per_device_batch": per_device_batch,
-                            "seq_len": seq_len,
-                            "attn_impl": attn_impl,
-                            "tokens_per_sec_per_device": round(tps / n_dev, 1),
-                            "platform": jax.devices()[0].platform,
-                        },
-                    }
-                )
+            _emit_record(
+                {
+                    "metric": f"{model_name}_synthetic_train_tokens_per_sec",
+                    "value": round(tps, 1),
+                    # no reference point: the reference is vision-only
+                    "unit": "tokens/sec",
+                    "vs_baseline": 0.0,
+                    "compile_sec": perf["compile_sec"],
+                    "host_sync_count": perf["host_sync_count"],
+                    "detail": {
+                        "devices": n_dev,
+                        "per_device_batch": per_device_batch,
+                        "seq_len": seq_len,
+                        "attn_impl": attn_impl,
+                        "tokens_per_sec_per_device": round(tps / n_dev, 1),
+                        "platform": jax.devices()[0].platform,
+                    },
+                }
             )
             return 0
         except Exception as e:
             last_err = e
             continue
-    print(
-        json.dumps(
-            {
-                "metric": f"{model_name}_synthetic_train_tokens_per_sec",
-                "value": 0.0,
-                "unit": "tokens/sec",
-                "vs_baseline": 0.0,
-                "error": repr(last_err),
-            }
-        )
+    _emit_record(
+        {
+            "metric": f"{model_name}_synthetic_train_tokens_per_sec",
+            "value": 0.0,
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,
+            "error": repr(last_err),
+        }
     )
     return 1
 
@@ -426,17 +448,16 @@ def _guard_device_init(
     metric, unit = _intended_metric()
 
     def _fail(msg: str) -> None:
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": 0.0,
-                    "unit": unit,
-                    "vs_baseline": 0.0,
-                    "error": msg,
-                }
-            ),
-            flush=True,
+        # _emit_record flushes the bus before the hard exit below (which
+        # skips atexit handlers on purpose — the backend may be hung).
+        _emit_record(
+            {
+                "metric": metric,
+                "value": 0.0,
+                "unit": unit,
+                "vs_baseline": 0.0,
+                "error": msg,
+            }
         )
         os._exit(1)
 
@@ -486,6 +507,16 @@ def _guard_device_init(
 def main():
     import os
 
+    if "--events" in sys.argv[1:] or os.environ.get("OBS_DIR"):
+        # Route the bus to OBS_DIR (or a fresh runs/bench-* dir): the
+        # spans and the result record below then persist as JSONL.
+        from distributeddeeplearning_tpu import obs
+
+        if not os.environ.get("OBS_DIR"):
+            os.environ["OBS_DIR"] = os.path.join(
+                "runs", f"bench-{int(time.time())}"
+            )
+        obs.configure_from_env()
     if os.environ.get("JAX_PLATFORMS"):
         # Honour an explicit platform pick in-process: the axon plugin
         # pins jax_platforms at interpreter start, so without this a
@@ -550,34 +581,32 @@ def main():
                     detail["scaling_efficiency"] = round(per_chip / ips1, 4)
                 except Exception as e:
                     detail["scaling_error"] = repr(e)
-            print(
-                json.dumps(
-                    {
-                        "metric": metric,
-                        "value": round(ips, 1),
-                        "unit": "images/sec",
-                        # vs_baseline only means something for the
-                        # canonical ResNet50@224 protocol
-                        "vs_baseline": round(
-                            per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3
-                        )
-                        if canonical
-                        else 0.0,
-                        "compile_sec": perf["compile_sec"],
-                        "host_sync_count": perf["host_sync_count"],
-                        "detail": detail,
-                    }
-                )
+            _emit_record(
+                {
+                    "metric": metric,
+                    "value": round(ips, 1),
+                    "unit": "images/sec",
+                    # vs_baseline only means something for the
+                    # canonical ResNet50@224 protocol
+                    "vs_baseline": round(
+                        per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3
+                    )
+                    if canonical
+                    else 0.0,
+                    "compile_sec": perf["compile_sec"],
+                    "host_sync_count": perf["host_sync_count"],
+                    "detail": detail,
+                }
             )
             return 0
         except Exception as e:  # OOM etc. → retry smaller batch
             last_err = e
             continue
-    print(json.dumps({
+    _emit_record({
         "metric": metric,
         "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
         "error": repr(last_err),
-    }))
+    })
     return 1
 
 

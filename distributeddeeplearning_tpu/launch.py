@@ -167,6 +167,7 @@ def launch_local(
     tag_output: bool = True,
     timeout: Optional[float] = None,
     hang_timeout: Optional[float] = None,
+    obs_dir: Optional[str] = None,
     sink=None,
 ) -> int:
     """Run ``script`` in ``num_processes`` local python processes.
@@ -181,9 +182,34 @@ def launch_local(
     the others already left never returns and never prints. If NO child
     produces a line of output for ``hang_timeout`` seconds, the world is
     declared hung and terminated (exit 125).
+
+    ``obs_dir``: the world's observability run directory. The launcher
+    writes its own lifecycle events (rendezvous, child start/exit,
+    watchdog/timeout fires) to ``events-launcher.jsonl`` there, exports
+    ``OBS_DIR``/``OBS_RUN_ID`` so every child's event bus lands next to
+    it, and — playing "host 0" — merges all part files into one
+    wall-clock-ordered ``events.jsonl`` when the world exits, whatever
+    the exit code. A watchdog/timeout kill is delivered as SIGTERM, so
+    children dump their flight-recorder rings before dying.
     """
     sink = sink or sys.stdout
     coordinator = f"127.0.0.1:{find_free_port()}"
+    lbus = None
+    extra_env = dict(env or {})
+    if obs_dir:
+        from distributeddeeplearning_tpu.obs import EventBus
+
+        obs_dir = os.path.abspath(obs_dir)
+        run_id = (
+            extra_env.get("OBS_RUN_ID")
+            or os.environ.get("OBS_RUN_ID")
+            or f"run-{int(time.time())}"
+        )
+        # A PRIVATE bus (not the process-global one): launching is an
+        # action inside some caller's process, not that process's run.
+        lbus = EventBus(directory=obs_dir, run_id=run_id, proc="launcher")
+        extra_env["OBS_DIR"] = obs_dir
+        extra_env["OBS_RUN_ID"] = run_id
     procs: List[subprocess.Popen] = []
     pumps: List[threading.Thread] = []
     heartbeat = [time.monotonic()]  # updated by every pump thread
@@ -195,7 +221,7 @@ def launch_local(
             process_id=pid,
             platform=platform,
             devices_per_process=devices_per_process,
-            extra_env=env,
+            extra_env=extra_env,
         )
         procs.append(
             subprocess.Popen(
@@ -207,7 +233,17 @@ def launch_local(
                 # watchdog sees un-newlined output too
             )
         )
+        if lbus is not None:
+            lbus.point("child_start", rank=pid, pid=procs[-1].pid)
         pumps.append(_stream(procs[-1], pid, tag_output, sink, heartbeat))
+    if lbus is not None:
+        lbus.point(
+            "rendezvous",
+            coordinator=coordinator,
+            num_processes=num_processes,
+            script=script,
+        )
+        lbus.flush()
 
     deadline = time.monotonic() + timeout if timeout else None
     exit_code = 0
@@ -218,6 +254,8 @@ def launch_local(
                 rc = procs[pid].poll()
                 if rc is not None:
                     live.discard(pid)
+                    if lbus is not None:
+                        lbus.point("child_exit", rank=pid, rc=rc)
                     if rc != 0 and exit_code == 0:
                         exit_code = rc
                         sink.write(
@@ -228,6 +266,8 @@ def launch_local(
             if deadline and time.monotonic() > deadline:
                 sink.write(f"launch: timeout after {timeout}s; terminating\n")
                 exit_code = 124
+                if lbus is not None:
+                    lbus.point("timeout_fired", timeout_s=timeout)
                 raise _ChildFailed()
             if (
                 hang_timeout
@@ -239,6 +279,8 @@ def launch_local(
                     "terminating\n"
                 )
                 exit_code = 125
+                if lbus is not None:
+                    lbus.point("watchdog_fired", silence_s=hang_timeout)
                 raise _ChildFailed()
             time.sleep(0.1)
     except (_ChildFailed, KeyboardInterrupt):
@@ -257,6 +299,19 @@ def launch_local(
     finally:
         for t in pumps:
             t.join(timeout=5)
+        if lbus is not None:
+            lbus.point("world_exit", rc=exit_code)
+            lbus.close()
+            try:
+                from distributeddeeplearning_tpu.obs.report import (
+                    merge_run_dir,
+                )
+
+                merged = merge_run_dir(obs_dir)
+                if merged:
+                    sink.write(f"launch: merged events -> {merged}\n")
+            except Exception as e:  # merging must never mask the run's rc
+                sink.write(f"launch: event merge failed: {e!r}\n")
     return exit_code
 
 
@@ -446,6 +501,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="kill the world if no process prints for this many seconds "
         "(deadlocked-collective watchdog)",
     )
+    ap.add_argument(
+        "--obs-dir",
+        default=os.environ.get("OBS_DIR") or None,
+        help="event-bus run directory: per-process events.jsonl, "
+        "launcher lifecycle events, merged report input "
+        "(default: $OBS_DIR; see docs/OBSERVABILITY.md)",
+    )
     ap.add_argument("--no-tag-output", action="store_true")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -464,6 +526,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ):
             if val is not None:
                 ap.error(f"{flag} applies to local mode only, not --tpu")
+        if args.obs_dir:
+            # Pod mode: no shared filesystem to merge on — each worker
+            # writes its own event files under OBS_DIR on its VM (fetch
+            # or stream them later; merging is the local-mode luxury).
+            extra_env.setdefault("OBS_DIR", args.obs_dir)
         return launch_pod(
             args.script,
             args.script_args,
@@ -490,6 +557,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         tag_output=not args.no_tag_output,
         timeout=args.timeout,
         hang_timeout=args.hang_timeout,
+        obs_dir=args.obs_dir,
     )
 
 

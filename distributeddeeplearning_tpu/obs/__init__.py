@@ -1,0 +1,41 @@
+"""Structured observability: event bus, flight recorder, trace capture.
+
+The repo-wide rule: layers emit *through* the bus, not around it. The
+training loop, warmup, checkpointing, host-sync accounting, launcher
+and job submitter all record spans/counters/gauges here; ``OBS_DIR``
+turns on per-process JSONL capture, the flight-recorder ring is always
+armed, and ``scripts/obs_report.py`` renders a merged run report. See
+``docs/OBSERVABILITY.md`` for the schema and knobs.
+"""
+
+from distributeddeeplearning_tpu.obs.bus import (
+    DEFAULT_RING_SIZE,
+    EventBus,
+    configure,
+    configure_from_env,
+    counter,
+    flush,
+    gauge,
+    get_bus,
+    install_crash_handlers,
+    point,
+    reset,
+    span,
+    span_event,
+)
+
+__all__ = [
+    "DEFAULT_RING_SIZE",
+    "EventBus",
+    "configure",
+    "configure_from_env",
+    "counter",
+    "flush",
+    "gauge",
+    "get_bus",
+    "install_crash_handlers",
+    "point",
+    "reset",
+    "span",
+    "span_event",
+]

@@ -1,0 +1,395 @@
+"""Process-local structured event bus + flight recorder.
+
+The repo's observability used to be stdout lines: the reference's
+``Timer`` print, PR 1's warmup/hostsync log lines, and ``bench.py``'s
+one-JSON-line protocol each spoke their own dialect, and a crashed or
+preempted process left nothing behind at all. This module is the one
+substrate under all of them:
+
+* :class:`EventBus` — spans, counters, gauges and point events, written
+  as JSONL with monotonic timestamps and run/host/process identity. One
+  file per process (``events-p<proc>.jsonl``); the first line is a
+  ``meta`` record carrying the (monotonic, wall) clock pair so a merger
+  can align files from different hosts.
+* **Flight recorder** — every event also lands in a bounded in-memory
+  ring; :func:`install_crash_handlers` dumps the ring to
+  ``flight-p<proc>.jsonl`` on unhandled exception or SIGTERM
+  (preemption / launcher watchdog kill), so a dead process leaves a
+  black box with its last N events even when nothing was ever flushed.
+* **Sync-free by construction** — emitting buffers a plain dict
+  host-side; nothing here may ever touch a jax array or the device.
+  The hot loop's instrumentation cost is a dict append; file writes
+  happen at epoch boundaries (``flush()``) or on the internal
+  batch-size threshold, never per event.
+
+Schema (one JSON object per line)::
+
+    {"kind": "meta", "schema": 1, "run": ..., "p": 0, "host": ...,
+     "pid": ..., "slice": ..., "mono0": ..., "wall0": ..., "argv": [...]}
+    {"t": <monotonic s>, "kind": "span",    "name": ..., "dur": <s>,
+     "labels": {...}, "p": 0, "seq": n}
+    {"t": ...,           "kind": "counter", "name": ..., "value": n, ...}
+    {"t": ...,           "kind": "gauge",   "name": ..., "value": x, ...}
+    {"t": ...,           "kind": "point",   "name": ..., ...}
+
+Knobs (env): ``OBS_DIR`` (run directory; unset = ring-only, no files),
+``OBS_RUN_ID`` (shared by the launcher so all processes of one world
+agree), ``OBS_RING_SIZE`` (flight-recorder depth, default 512).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional, Union
+
+SCHEMA_VERSION = 1
+DEFAULT_RING_SIZE = 512
+_AUTOFLUSH_EVERY = 256
+
+
+def _proc_tag(proc: Union[int, str]) -> str:
+    return f"p{proc}" if isinstance(proc, int) else str(proc)
+
+
+class EventBus:
+    """A process-local structured event sink (JSONL + ring buffer).
+
+    ``directory=None`` keeps the bus ring-only: events are recorded in
+    memory (so a later :meth:`dump_flight` still works) but nothing is
+    written. All methods are thread-safe and never raise into the
+    instrumented code path.
+    """
+
+    def __init__(
+        self,
+        *,
+        directory: Optional[str] = None,
+        run_id: Optional[str] = None,
+        proc: Optional[Union[int, str]] = None,
+        ring_size: int = DEFAULT_RING_SIZE,
+        identity: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self._lock = threading.Lock()
+        if proc is None:
+            proc = int(os.environ.get("DDL_PROCESS_ID", "0"))
+        self.proc = proc
+        self.run_id = run_id or f"run-{int(time.time())}-{os.getpid()}"
+        self.directory = os.path.abspath(directory) if directory else None
+        self.ring: collections.deque = collections.deque(maxlen=max(ring_size, 1))
+        self._buffer: list = []
+        self._seq = 0
+        self._fh = None
+        self.path: Optional[str] = None
+        self.meta: Dict[str, Any] = {
+            "kind": "meta",
+            "schema": SCHEMA_VERSION,
+            "run": self.run_id,
+            "p": self.proc,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "slice": os.environ.get("DDL_SLICE"),
+            # The clock pair every consumer needs to align this file with
+            # others: wall = wall0 + (t - mono0).
+            "mono0": time.monotonic(),
+            "wall0": time.time(),
+            "argv": list(sys.argv),
+        }
+        if identity:
+            self.meta.update(identity)
+        if self.directory:
+            os.makedirs(self.directory, exist_ok=True)
+            self.path = os.path.join(
+                self.directory, f"events-{_proc_tag(self.proc)}.jsonl"
+            )
+            self._fh = open(self.path, "w")
+            self._fh.write(json.dumps(self.meta, default=str) + "\n")
+            self._fh.flush()
+
+    # -- emission ----------------------------------------------------------
+
+    def emit(
+        self,
+        kind: str,
+        name: str,
+        *,
+        value: Any = None,
+        dur: Optional[float] = None,
+        t: Optional[float] = None,
+        labels: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record one event (host-side dict append; no device work)."""
+        rec: Dict[str, Any] = {
+            "t": time.monotonic() if t is None else t,
+            "kind": kind,
+            "name": name,
+            "p": self.proc,
+        }
+        if value is not None:
+            rec["value"] = value
+        if dur is not None:
+            rec["dur"] = dur
+        if labels:
+            rec["labels"] = labels
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self.ring.append(rec)
+            if self._fh is not None:
+                self._buffer.append(rec)
+                if len(self._buffer) >= _AUTOFLUSH_EVERY:
+                    self._flush_locked()
+
+    def counter(self, name: str, n: int = 1, **labels: Any) -> None:
+        self.emit("counter", name, value=n, labels=labels or None)
+
+    def gauge(self, name: str, value: float, **labels: Any) -> None:
+        self.emit("gauge", name, value=value, labels=labels or None)
+
+    def point(self, name: str, **labels: Any) -> None:
+        self.emit("point", name, labels=labels or None)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **labels: Any) -> Iterator[None]:
+        """Time a block; emits one ``span`` event at exit (t = start)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.emit(
+                "span", name, t=t0, dur=time.monotonic() - t0,
+                labels=labels or None,
+            )
+
+    def span_event(
+        self, name: str, dur: float, t: Optional[float] = None, **labels: Any
+    ) -> None:
+        """A span whose duration was measured elsewhere (e.g. the step
+        dispatch clock) — ``t`` defaults to "it just ended"."""
+        if t is None:
+            t = time.monotonic() - dur
+        self.emit("span", name, t=t, dur=dur, labels=labels or None)
+
+    # -- persistence -------------------------------------------------------
+
+    def _flush_locked(self) -> None:
+        if self._fh is None or not self._buffer:
+            return
+        self._fh.write(
+            "".join(json.dumps(r, default=str) + "\n" for r in self._buffer)
+        )
+        self._fh.flush()
+        self._buffer.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def dump_flight(
+        self, reason: str, path: Optional[str] = None
+    ) -> Optional[str]:
+        """Write the ring (last N events) to disk — the black box.
+
+        Called by the crash handlers on unhandled exception / SIGTERM;
+        callable directly too. Ring-only buses with no directory dump
+        next to the cwd so a crash still leaves evidence."""
+        with self._lock:
+            recs = list(self.ring)
+        if path is None:
+            base = self.directory or os.getcwd()
+            path = os.path.join(base, f"flight-{_proc_tag(self.proc)}.jsonl")
+        header = dict(self.meta)
+        header["kind"] = "flight_meta"
+        header["reason"] = reason
+        header["dump_wall"] = time.time()
+        header["dump_t"] = time.monotonic()
+        try:
+            with open(path, "w") as fh:
+                fh.write(json.dumps(header, default=str) + "\n")
+                for r in recs:
+                    fh.write(json.dumps(r, default=str) + "\n")
+        except OSError:
+            return None
+        return path
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked()
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+# ---------------------------------------------------------------------------
+# Process-global bus + crash handlers
+# ---------------------------------------------------------------------------
+
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[EventBus] = None
+_handlers_installed = False
+_prev_excepthook = None
+_prev_sigterm = None
+
+
+def get_bus() -> EventBus:
+    """The process-global bus (ring-only until :func:`configure` runs),
+    so instrumentation sites never need to check whether observability
+    is on."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = EventBus()
+        return _GLOBAL
+
+
+def configure(
+    directory: Optional[str],
+    *,
+    run_id: Optional[str] = None,
+    ring_size: Optional[int] = None,
+    proc: Optional[Union[int, str]] = None,
+    install_handlers: bool = True,
+) -> EventBus:
+    """(Re)point the global bus at ``directory`` (None = back to
+    ring-only) and install the crash handlers. Returns the new bus."""
+    global _GLOBAL
+    if ring_size is None:
+        ring_size = int(os.environ.get("OBS_RING_SIZE", str(DEFAULT_RING_SIZE)))
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.close()
+        _GLOBAL = EventBus(
+            directory=directory, run_id=run_id, proc=proc, ring_size=ring_size
+        )
+        bus = _GLOBAL
+    if directory and install_handlers:
+        install_crash_handlers()
+    return bus
+
+
+def configure_from_env(env=None) -> EventBus:
+    """Honour ``OBS_DIR``/``OBS_RUN_ID``/``OBS_RING_SIZE`` (idempotent:
+    a bus already writing to OBS_DIR is kept). With no ``OBS_DIR`` the
+    existing (possibly ring-only) bus is returned unchanged."""
+    e = os.environ if env is None else env
+    directory = e.get("OBS_DIR")
+    if not directory:
+        return get_bus()
+    bus = get_bus()
+    if bus.directory == os.path.abspath(directory):
+        return bus
+    return configure(directory, run_id=e.get("OBS_RUN_ID"))
+
+
+def install_crash_handlers() -> None:
+    """Chain an excepthook + SIGTERM handler that dump the flight ring.
+
+    SIGTERM matters twice here: it is what the launcher's watchdog sends
+    a hung world, and what a preempted TPU VM receives — both are
+    exactly the moments a black box is worth the most. Handlers chain to
+    whatever was installed before and re-deliver the signal so exit
+    semantics are unchanged."""
+    global _handlers_installed, _prev_excepthook, _prev_sigterm
+    if _handlers_installed:
+        return
+    _prev_excepthook = sys.excepthook
+
+    def _hook(tp, val, tb):
+        try:
+            bus = get_bus()
+            bus.point("crash", error=repr(val), type=tp.__name__)
+            bus.dump_flight(f"exception:{tp.__name__}")
+            bus.flush()
+        except Exception:
+            pass
+        _prev_excepthook(tp, val, tb)
+
+    sys.excepthook = _hook
+    if threading.current_thread() is threading.main_thread():
+        try:
+            _prev_sigterm = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                try:
+                    bus = get_bus()
+                    bus.point("sigterm")
+                    bus.dump_flight("sigterm")
+                    bus.flush()
+                except Exception:
+                    pass
+                prev = _prev_sigterm
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            _prev_sigterm = None
+    _handlers_installed = True
+
+
+def reset() -> None:
+    """Tests only: restore handlers and drop back to a fresh ring-only
+    bus."""
+    global _GLOBAL, _handlers_installed, _prev_excepthook, _prev_sigterm
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.close()
+        _GLOBAL = None
+    if _handlers_installed:
+        if _prev_excepthook is not None:
+            sys.excepthook = _prev_excepthook
+        if _prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, _prev_sigterm)
+            except (ValueError, OSError):
+                pass
+        _handlers_installed = False
+        _prev_excepthook = None
+        _prev_sigterm = None
+
+
+@atexit.register
+def _close_at_exit() -> None:  # pragma: no cover - interpreter teardown
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.close()
+
+
+# Module-level conveniences: route to the global bus so call sites read
+# `obs.counter(...)` without holding a bus reference.
+
+def counter(name: str, n: int = 1, **labels: Any) -> None:
+    get_bus().counter(name, n, **labels)
+
+
+def gauge(name: str, value: float, **labels: Any) -> None:
+    get_bus().gauge(name, value, **labels)
+
+
+def point(name: str, **labels: Any) -> None:
+    get_bus().point(name, **labels)
+
+
+def span(name: str, **labels: Any):
+    return get_bus().span(name, **labels)
+
+
+def span_event(
+    name: str, dur: float, t: Optional[float] = None, **labels: Any
+) -> None:
+    get_bus().span_event(name, dur, t=t, **labels)
+
+
+def flush() -> None:
+    get_bus().flush()

@@ -1,0 +1,295 @@
+"""Merge + summarize event-bus JSONL files into a run report.
+
+Consumes the files :mod:`distributeddeeplearning_tpu.obs.bus` writes —
+one ``events-p<k>.jsonl`` per process (plus the launcher's
+``events-launcher.jsonl``) — and renders the run-level picture the old
+stdout logs could never reconstruct: a per-process timeline, span
+duration percentiles, host-sync counts by call-site label, compile vs
+step time, and cross-process (epoch-boundary) skew.
+
+Merging aligns clocks via each file's ``meta`` line: every event's wall
+time is ``meta.wall0 + (t - meta.mono0)``, so files from different
+hosts/processes sort into one consistent timeline. ``merge_run_dir`` is
+what the launcher calls at world exit ("host 0 merges"); the CLI
+(``scripts/obs_report.py``) accepts a run directory, a merged file, or
+any set of part files.
+
+This module is deliberately jax-free: a report must be renderable on a
+machine with no accelerator stack at all (e.g. from artifacts copied off
+a preempted pod).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+MERGED_BASENAME = "events.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# Loading + merging
+# ---------------------------------------------------------------------------
+
+def _part_files(directory: str) -> List[str]:
+    """Per-process event files in a run dir (flight dumps excluded —
+    they duplicate ring events that may also have been flushed)."""
+    out = []
+    for p in sorted(glob.glob(os.path.join(directory, "events*.jsonl"))):
+        if os.path.basename(p) != MERGED_BASENAME:
+            out.append(p)
+    return out
+
+
+def discover(paths: Iterable[str]) -> List[str]:
+    """Resolve CLI arguments (dirs / files) to concrete event files.
+    A directory resolves to its merged ``events.jsonl`` when present,
+    else to all its part files."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            merged = os.path.join(p, MERGED_BASENAME)
+            if os.path.exists(merged):
+                files.append(merged)
+            else:
+                files.extend(_part_files(p))
+        elif os.path.exists(p):
+            files.append(p)
+        else:
+            raise FileNotFoundError(p)
+    return files
+
+
+def _parse_file(path: str) -> Tuple[List[dict], List[dict]]:
+    metas, events = [], []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # truncated tail line from a killed process
+            if rec.get("kind") in ("meta", "flight_meta"):
+                metas.append(rec)
+            else:
+                events.append(rec)
+    return metas, events
+
+
+def load(paths: Iterable[str]) -> Dict[str, Any]:
+    """Load event files into ``{"metas": {p: meta}, "events": [...]}``.
+
+    Every event gains a ``wall`` field computed from its process's meta
+    clock pair; events from a process with no meta line keep monotonic
+    time only (``wall = None``) and sort last.
+    """
+    files = discover(paths)
+    if not files:
+        raise FileNotFoundError("no event files found")
+    metas: Dict[Any, dict] = {}
+    events: List[dict] = []
+    for f in files:
+        ms, evs = _parse_file(f)
+        for m in ms:
+            # First meta per process wins (merged files repeat them).
+            metas.setdefault(m.get("p"), m)
+        events.extend(evs)
+    for e in events:
+        m = metas.get(e.get("p"))
+        if m is not None and "t" in e:
+            e["wall"] = m["wall0"] + (e["t"] - m["mono0"])
+        else:
+            e.setdefault("wall", None)
+    events.sort(key=lambda e: (e["wall"] is None, e.get("wall") or 0.0))
+    return {"metas": metas, "events": events, "files": files}
+
+
+def merge_run_dir(
+    directory: str, out_name: str = MERGED_BASENAME
+) -> Optional[str]:
+    """Merge every part file in ``directory`` into one wall-clock-sorted
+    ``events.jsonl`` (meta lines first). Returns the merged path, or
+    None when there was nothing to merge."""
+    parts = _part_files(directory)
+    if not parts:
+        return None
+    loaded = load(parts)
+    out = os.path.join(directory, out_name)
+    with open(out, "w") as fh:
+        for _, meta in sorted(
+            loaded["metas"].items(), key=lambda kv: str(kv[0])
+        ):
+            fh.write(json.dumps(meta, default=str) + "\n")
+        for e in loaded["events"]:
+            fh.write(json.dumps(e, default=str) + "\n")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Summarising
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
+    """Aggregate a loaded run into the report's data model."""
+    events = loaded["events"]
+    spans: Dict[str, List[float]] = {}
+    span_total: Dict[str, float] = {}
+    counters: Dict[str, float] = {}
+    sync_by_label: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    points: Dict[str, int] = {}
+    procs: Dict[Any, Dict[str, Any]] = {}
+    # name -> epoch -> {proc: end_wall}; cross-process skew is read off
+    # the per-epoch boundary (every process ends epoch k once).
+    epoch_ends: Dict[Any, Dict[Any, float]] = {}
+
+    for e in events:
+        p = e.get("p")
+        info = procs.setdefault(
+            p, {"events": 0, "first_wall": None, "last_wall": None}
+        )
+        info["events"] += 1
+        w = e.get("wall")
+        if w is not None:
+            if info["first_wall"] is None:
+                info["first_wall"] = w
+            info["last_wall"] = w
+        kind, name = e.get("kind"), e.get("name", "")
+        labels = e.get("labels") or {}
+        if kind == "span":
+            dur = float(e.get("dur", 0.0))
+            spans.setdefault(name, []).append(dur)
+            span_total[name] = span_total.get(name, 0.0) + dur
+            if name == "epoch" and w is not None:
+                epoch_ends.setdefault(labels.get("epoch"), {})[p] = w + dur
+        elif kind == "counter":
+            counters[name] = counters.get(name, 0) + float(e.get("value", 1))
+            if name == "host_sync":
+                lbl = labels.get("label", "?")
+                sync_by_label[lbl] = sync_by_label.get(lbl, 0) + int(
+                    e.get("value", 1)
+                )
+        elif kind == "gauge":
+            gauges[name] = e.get("value")
+        elif kind == "point":
+            points[name] = points.get(name, 0) + 1
+
+    span_stats = {}
+    for name, durs in spans.items():
+        d = sorted(durs)
+        span_stats[name] = {
+            "count": len(d),
+            "total_s": sum(d),
+            "p50_ms": _percentile(d, 0.50) * 1e3,
+            "p99_ms": _percentile(d, 0.99) * 1e3,
+            "max_ms": d[-1] * 1e3,
+        }
+
+    # Per-host skew: how far apart processes finish the same epoch.
+    skews = []
+    for epoch, by_proc in epoch_ends.items():
+        if len(by_proc) > 1:
+            vals = list(by_proc.values())
+            skews.append((max(vals) - min(vals)) * 1e3)
+    for p, meta in loaded["metas"].items():
+        if p in procs:
+            procs[p]["host"] = meta.get("host")
+            procs[p]["pid"] = meta.get("pid")
+            procs[p]["slice"] = meta.get("slice")
+
+    compile_s = sum(
+        v["total_s"] for k, v in span_stats.items() if "compile" in k
+    )
+    step_s = span_stats.get("step", {}).get("total_s", 0.0)
+    run_ids = {m.get("run") for m in loaded["metas"].values()}
+    return {
+        "run_ids": sorted(r for r in run_ids if r),
+        "files": loaded["files"],
+        "procs": procs,
+        "spans": span_stats,
+        "counters": counters,
+        "host_sync_by_label": sync_by_label,
+        "gauges": gauges,
+        "points": points,
+        "compile_s": compile_s,
+        "step_s": step_s,
+        "max_epoch_skew_ms": max(skews) if skews else 0.0,
+        "epochs_seen": len(epoch_ends),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def render(summary: Dict[str, Any], top_n: int = 20) -> str:
+    """Human-readable run report (one string, print-ready)."""
+    out: List[str] = []
+    add = out.append
+    add(f"run: {', '.join(summary['run_ids']) or '<unknown>'}")
+    add(f"files: {len(summary['files'])}")
+    add("")
+    add("timeline (per process):")
+    t0s = [
+        i["first_wall"] for i in summary["procs"].values()
+        if i.get("first_wall") is not None
+    ]
+    base = min(t0s) if t0s else 0.0
+    for p, info in sorted(summary["procs"].items(), key=lambda kv: str(kv[0])):
+        fw, lw = info.get("first_wall"), info.get("last_wall")
+        spanstr = (
+            f"+{fw - base:8.3f}s .. +{lw - base:8.3f}s"
+            if fw is not None else "<no wall clock>"
+        )
+        host = info.get("host", "?")
+        add(
+            f"  [{p}] {spanstr}  {info['events']:6d} events"
+            f"  host={host} pid={info.get('pid', '?')}"
+        )
+    add("")
+    add(f"{'span':32s} {'count':>7s} {'total s':>9s} "
+        f"{'p50 ms':>9s} {'p99 ms':>9s} {'max ms':>9s}")
+    ranked = sorted(
+        summary["spans"].items(), key=lambda kv: -kv[1]["total_s"]
+    )[:top_n]
+    for name, s in ranked:
+        add(
+            f"{name:32s} {s['count']:7d} {s['total_s']:9.3f} "
+            f"{s['p50_ms']:9.3f} {s['p99_ms']:9.3f} {s['max_ms']:9.3f}"
+        )
+    add("")
+    add(f"compile vs step time: compile {summary['compile_s']:.3f}s, "
+        f"step {summary['step_s']:.3f}s")
+    if summary["epochs_seen"]:
+        add(f"epochs: {summary['epochs_seen']}, max cross-process "
+            f"epoch-end skew: {summary['max_epoch_skew_ms']:.1f} ms")
+    if summary["host_sync_by_label"]:
+        add("host syncs (device->host materialisations) by call site:")
+        for lbl, n in sorted(
+            summary["host_sync_by_label"].items(), key=lambda kv: -kv[1]
+        ):
+            add(f"  {lbl:30s} {n:6d}")
+    if summary["counters"]:
+        add("counters:")
+        for name, v in sorted(summary["counters"].items()):
+            add(f"  {name:30s} {v:10.0f}")
+    if summary["gauges"]:
+        add("final gauges:")
+        for name, v in sorted(summary["gauges"].items()):
+            add(f"  {name:30s} {v}")
+    if summary["points"]:
+        add("events: " + ", ".join(
+            f"{k}x{v}" for k, v in sorted(summary["points"].items())
+        ))
+    return "\n".join(out)

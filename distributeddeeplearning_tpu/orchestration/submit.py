@@ -34,6 +34,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
+from distributeddeeplearning_tpu import obs
 from distributeddeeplearning_tpu.launch import build_pod_command, ssh_command
 from distributeddeeplearning_tpu.utils.env import (
     dotenv_for,
@@ -162,13 +163,15 @@ def _call_surfaced(cmd: Sequence[str]) -> int:
     unreachable, job crashed in foreground mode, worker ssh refused)
     prints an ERROR line naming the command instead of silently becoming
     the exit code."""
-    rc = subprocess.call(list(cmd))
+    with obs.span("gcloud", what=cmd[0] if cmd else "?"):
+        rc = subprocess.call(list(cmd))
     if rc != 0:
         sys.stderr.write(
             f"ERROR: command failed (rc={rc}): "
             + " ".join(shlex.quote(c) for c in cmd)
             + "\n"
         )
+        obs.point("gcloud_failed", rc=rc)
     return rc
 
 
@@ -240,9 +243,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     slices = parse_slices(envfile.get("SLICES"))
     nodes = multislice_node_names(tpu, slices) if slices > 1 else [tpu]
 
+    # Orchestration actions emit through the event bus too (OBS_DIR
+    # turns on JSONL capture; ring-only otherwise): a run's report can
+    # then show when it was submitted/streamed/stopped and from where.
+    bus = obs.configure_from_env()
+
     if args.cmd == "run":
         job = args.job or f"job-{int(time.time())}"
         env = _parse_env(args.env)
+        bus.point(
+            "submit_run", job=job, tpu=tpu, zone=zone,
+            detach=bool(args.detach), slices=len(nodes), script=args.script,
+        )
         if len(nodes) > 1 and not args.detach:
             ap.error(
                 "multi-slice submit requires --detach: all slices must "
@@ -286,6 +298,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return rc
         return 0
 
+    bus.point(f"submit_{args.cmd}", job=args.job, tpu=tpu, zone=zone)
     if args.cmd == "stream":
         if not 0 <= args.slice < len(nodes):
             ap.error(

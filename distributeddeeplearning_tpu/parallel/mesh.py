@@ -104,8 +104,9 @@ def mesh_from_config(config) -> Mesh:
     if tuple(config.mesh_axes)[:1] == (REPLICA_AXIS,):
         # MESH_AXES=replica,... — multi-slice: replica is the DCN axis and
         # must be built via the hybrid constructor so slice grouping is
-        # honoured. MESH_SHAPE[0] fixes the slice count; default = 2 when
-        # unspecified (all devices when replica is the only axis).
+        # honoured. MESH_SHAPE[0] fixes the slice count; when unspecified
+        # it is derived from hardware (Device.slice_index) or it's an
+        # error (all devices when replica is the only axis).
         inner_axes = tuple(config.mesh_axes)[1:]
         if config.mesh_shape is not None:
             if len(config.mesh_shape) != len(config.mesh_axes):
@@ -120,16 +121,25 @@ def mesh_from_config(config) -> Mesh:
             # KNOW their slice (Device.slice_index) — use that count, so
             # the documented `submit --env MESH_AXES=replica,data` flow
             # works on any slice count (ADVICE r5: the old hardcoded 2
-            # crashed every pod with != 2 slices). The even-split-to-2
-            # heuristic remains only for virtual devices (CPU tests)
-            # which expose no slice_index.
+            # crashed every pod with != 2 slices). Devices with no
+            # slice_index (virtual CPU devices, single-slice runtimes)
+            # carry no topology to derive from — ERROR rather than
+            # guess: a silently wrong split ships every gradient byte
+            # over DCN (VERDICT r5 item 4 killed the old default of 2).
             devs = jax.devices()
             n = len(devs)
             slice_ids = {getattr(d, "slice_index", None) for d in devs}
             if inner_axes and None not in slice_ids:
                 num_slices = len(slice_ids)
             elif inner_axes:
-                num_slices = 2 if n % 2 == 0 else 1
+                raise ValueError(
+                    f"MESH_AXES={','.join(config.mesh_axes)} without "
+                    f"MESH_SHAPE: these {n} "
+                    f"{getattr(devs[0], 'platform', '?')} devices expose "
+                    "no slice_index, so the slice count cannot be "
+                    "derived from hardware — set "
+                    "MESH_SHAPE=<slices>,<per-slice …> explicitly"
+                )
             else:
                 num_slices = n
             inner_shape = None

@@ -25,6 +25,7 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from distributeddeeplearning_tpu import obs
 from distributeddeeplearning_tpu.utils.logging import get_logger
 
 PyTree = Any
@@ -70,7 +71,8 @@ class CheckpointManager:
             return False
         if not force and (epoch + 1) % self._save_every != 0:
             return False
-        saved = self._mgr.save(epoch, args=ocp.args.StandardSave(state))
+        with obs.span("checkpoint_save", epoch=epoch):
+            saved = self._mgr.save(epoch, args=ocp.args.StandardSave(state))
         if saved:
             self._log.info("checkpoint saved", extra={"epoch": epoch})
         return bool(saved)
@@ -93,7 +95,10 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        with obs.span("checkpoint_restore", epoch=step):
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
         self._log.info("checkpoint restored", extra={"epoch": step})
         return restored
 

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 import optax
 
+from distributeddeeplearning_tpu import obs
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device
 from distributeddeeplearning_tpu.parallel import collectives
@@ -164,6 +165,13 @@ def fit(
     averaged, Keras ``:344-353``), and prints the ``_log_summary`` block.
     """
     log = get_logger()
+    # Event bus: OBS_DIR turns on JSONL capture (per-process file, flight
+    # recorder armed); without it the bus stays ring-only and every emit
+    # below is a host-side dict append. Either way: zero device work.
+    bus = obs.configure_from_env()
+    from distributeddeeplearning_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.from_env()
     if config.compilation_cache_dir:
         # Before any compile (engine init included): re-runs of the same
         # program deserialize executables instead of re-invoking XLA.
@@ -257,8 +265,20 @@ def fit(
     total_images = 0
     callback_list.on_train_begin({"state": state})
 
+    bus.point(
+        "run_begin",
+        engine=engine_name,
+        model=config.model,
+        epochs=epochs,
+        start_epoch=start_epoch,
+        steps_per_epoch=steps_per_epoch,
+        devices=jax.device_count(),
+    )
     metrics = {}
     for epoch in range(start_epoch, epochs):
+        if tracer is not None:
+            tracer.maybe_start(epoch)
+        epoch_t0 = time.monotonic()
         callback_list.on_epoch_begin(epoch)
         step_in_epoch = 0
         # Fresh on-device accumulator per epoch: metric sums + step count
@@ -281,7 +301,12 @@ def fit(
                 state, metrics, acc = train_step(state, batch, acc)
             else:
                 state, metrics = train_step(state, batch)
-            clock.note_dispatch(time.perf_counter() - t0)
+            dispatch_s = time.perf_counter() - t0
+            clock.note_dispatch(dispatch_s)
+            # Step span = dispatch time (host-side float, already in
+            # hand): the bus sees every step with no extra measurement
+            # and, critically, no materialisation of device values.
+            bus.span_event("step", dispatch_s, epoch=epoch)
             step_in_epoch += 1
             if (
                 config.log_every_steps
@@ -303,7 +328,7 @@ def fit(
         # means (or, for a legacy step without the accumulator contract,
         # the last step's metrics) in a single device_get.
         epoch_values = finalize_accumulator(acc) if accumulates else metrics
-        with clock.waiting():
+        with clock.waiting(), bus.span("epoch_materialize", epoch=epoch):
             epoch_logs: Dict[str, Any] = {
                 k: float(v)
                 for k, v in hostsync.device_get(
@@ -320,10 +345,25 @@ def fit(
             epoch_logs.update({f"val_{k}": v for k, v in eval_metrics.items()})
 
         history.append({k: v for k, v in epoch_logs.items() if k != "state"})
+        # Epoch metrics enter the bus HERE — at the existing boundary,
+        # from host floats already materialised above (no extra sync).
+        for k, v in epoch_logs.items():
+            if isinstance(v, (int, float)):
+                bus.gauge(f"epoch.{k}", float(v), epoch=epoch)
         epoch_logs["state"] = state
         callback_list.on_epoch_end(epoch, epoch_logs)
         if engine_saves:
             ckpt.save(epoch, state)
+        bus.span_event(
+            "epoch",
+            time.monotonic() - epoch_t0,
+            t=epoch_t0,
+            epoch=epoch,
+            steps=step_in_epoch,
+        )
+        if tracer is not None:
+            tracer.maybe_stop(epoch)
+        bus.flush()  # epoch boundary: the one place events hit disk
 
     run_timer.stop()
     callback_list.on_train_end({"state": state})
@@ -350,6 +390,12 @@ def fit(
         dataset_kind="synthetic" if config.fake else "real",
         extra_fields=extra,
     )
+    # FitResult.perf, machine-readable: the same numbers the stdout
+    # summary prints, queryable from the merged run report.
+    for k, v in perf.items():
+        bus.gauge(f"perf.{k}", float(v))
+    bus.point("run_end", images_per_sec=round(images_per_sec, 1))
+    bus.flush()
     return FitResult(
         state=state,
         history=history,

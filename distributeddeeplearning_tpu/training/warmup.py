@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 
+from distributeddeeplearning_tpu import obs
 from distributeddeeplearning_tpu.utils.logging import get_logger
 
 _stats = {"hits": 0, "misses": 0}
@@ -39,10 +40,15 @@ _listener_installed = False
 
 
 def _on_event(event: str, **kw) -> None:
+    # jax's monitoring events are the ground truth for persistent-cache
+    # behaviour; mirror them onto the event bus so a run report can show
+    # warm-vs-cold starts without parsing log lines.
     if event.endswith("/cache_hits"):
         _stats["hits"] += 1
+        obs.counter("xla_cache_hit")
     elif event.endswith("/cache_misses"):
         _stats["misses"] += 1
+        obs.counter("xla_cache_miss")
 
 
 def install_cache_listener() -> bool:
@@ -142,13 +148,15 @@ def warmup_engine(
 
     step = eng.train_step
     if hasattr(step, "aot_compile"):
-        compiled, secs = step.aot_compile(eng.state, batch, acc)
+        with obs.span("compile", what="train_step", engine=eng.name):
+            compiled, secs = step.aot_compile(eng.state, batch, acc)
         info["train_compile_sec"] = secs
         flops = cost_analysis_flops(compiled)
         if flops is not None:
             info["train_flops_per_step"] = flops
     if eval_batch is not None and hasattr(eng.eval_step, "aot_compile"):
-        _, secs = eng.eval_step.aot_compile(eng.state, eval_batch)
+        with obs.span("compile", what="eval_step", engine=eng.name):
+            _, secs = eng.eval_step.aot_compile(eng.state, eval_batch)
         info["eval_compile_sec"] = secs
 
     hits1, misses1 = cache_stats()

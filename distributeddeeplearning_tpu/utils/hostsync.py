@@ -43,6 +43,13 @@ class SyncAccountant:
         with self._lock:
             self.count += n
             self.by_label[label] = self.by_label.get(label, 0) + n
+        # Mirror onto the event bus with the call-site label, so the run
+        # report shows WHERE materialisations happen, not just how many.
+        # Import here (not module top) to keep this module importable
+        # with zero package dependencies; emits are host-side appends.
+        from distributeddeeplearning_tpu import obs
+
+        obs.counter("host_sync", n, label=label)
 
     def reset(self) -> None:
         with self._lock:

@@ -1,0 +1,140 @@
+"""Keep ``tests/heavy_tests.txt`` (and the TESTING.md tier table) honest.
+
+The fast/full test split is data: ``heavy_tests.txt`` lists nodeids
+measured >= ~25 s, and ``conftest`` tags them ``heavy``+``slow`` at
+collection. Two ways that data rots (VERDICT r5 items 5/7): tests get
+renamed/removed and the list keeps stale nodeids, and the TESTING.md
+tier table's collected/deselected counts drift from reality. This
+script closes both:
+
+* default mode (``make heavy-refresh``) — runs ``pytest
+  --collect-only``, prunes heavy entries that no longer collect, and
+  prints the tier numbers (collected / heavy / fast) that belong in the
+  TESTING.md table;
+* ``--from-durations LOG`` — full regeneration from a measured
+  ``pytest --durations=N`` run log (every ``call`` >= ``--threshold``
+  seconds becomes heavy), replacing the fragile grep/awk recipe the doc
+  used to carry.
+
+Exit code 1 when the pruned list differs from what was on disk and
+``--check`` was passed (CI drift guard); always writes otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from typing import List, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEAVY_FILE = os.path.join(REPO, "tests", "heavy_tests.txt")
+
+# `12.34s call tests/test_x.py::test_y` — pytest --durations line
+_DURATION_RE = re.compile(r"^\s*([0-9.]+)s\s+call\s+(\S+)")
+
+
+def collected_nodeids() -> List[str]:
+    """Every nodeid pytest currently collects (CPU platform forced —
+    collection imports test modules, which import jax)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/", "-q",
+            "--collect-only", "-p", "no:cacheprovider",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    ids = [
+        ln.strip() for ln in res.stdout.splitlines()
+        if "::" in ln and not ln.startswith(("=", "<", " "))
+    ]
+    if not ids:
+        raise SystemExit(
+            f"pytest --collect-only produced no nodeids (rc={res.returncode}):\n"
+            + res.stdout[-2000:] + res.stderr[-2000:]
+        )
+    return ids
+
+
+def parse_durations_log(lines, threshold_s: float) -> List[str]:
+    """Nodeids whose measured ``call`` duration >= threshold (the awk
+    filter from the old TESTING.md recipe, kept exact: without it every
+    top-N test lands in the heavy list and the fast tier silently
+    shrinks)."""
+    out = []
+    for ln in lines:
+        m = _DURATION_RE.match(ln)
+        if m and float(m.group(1)) >= threshold_s:
+            out.append(m.group(2))
+    return out
+
+
+def read_heavy() -> List[str]:
+    try:
+        with open(HEAVY_FILE) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def write_heavy(ids: List[str]) -> None:
+    with open(HEAVY_FILE, "w") as f:
+        f.write("\n".join(ids) + ("\n" if ids else ""))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--from-durations", metavar="LOG", default=None,
+        help="regenerate the whole list from a measured --durations log",
+    )
+    p.add_argument("--threshold", type=float, default=25.0)
+    p.add_argument(
+        "--check", action="store_true",
+        help="don't write; exit 1 if the list on disk is stale",
+    )
+    args = p.parse_args(argv)
+
+    current: Set[str] = set(collected_nodeids())
+    heavy = read_heavy()
+
+    if args.from_durations:
+        with open(args.from_durations) as f:
+            measured = parse_durations_log(f, args.threshold)
+        new = sorted(set(measured) & current)
+        dropped_uncollected = sorted(set(measured) - current)
+        if dropped_uncollected:
+            print(f"ignored {len(dropped_uncollected)} measured-but-not-"
+                  f"collected nodeids: {dropped_uncollected}")
+    else:
+        new = [nid for nid in heavy if nid in current]
+        stale = [nid for nid in heavy if nid not in current]
+        if stale:
+            print(f"pruning {len(stale)} stale heavy entries:")
+            for nid in stale:
+                print(f"  - {nid}")
+
+    n_total, n_heavy = len(current), len(new)
+    print(f"tier numbers for docs/TESTING.md: {n_total} collected, "
+          f"{n_heavy} heavy/slow (deselected by fast tiers), "
+          f"{n_total - n_heavy} fast")
+
+    if new == heavy:
+        print(f"{HEAVY_FILE} is current ({n_heavy} entries)")
+        return 0
+    if args.check:
+        print(f"STALE: {HEAVY_FILE} needs refreshing (run make heavy-refresh)")
+        return 1
+    write_heavy(new)
+    print(f"wrote {HEAVY_FILE} ({len(heavy)} -> {n_heavy} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""Render a run report from event-bus JSONL files.
+
+The read side of the flight-recorder/event-bus layer
+(``distributeddeeplearning_tpu/obs/``): point it at a run directory
+(``OBS_DIR``) or any set of ``events*.jsonl`` files — local-mode runs
+are merged by the launcher into ``<dir>/events.jsonl`` already; this
+also merges on the fly when only part files exist.
+
+Usage::
+
+    python scripts/obs_report.py RUN_DIR_OR_FILES... [--json] [--top N]
+
+Prints the timeline, span duration p50/p99, host-sync counts by call
+site, compile vs step time, and per-host epoch skew. ``--json`` emits
+the summary as one JSON object for machine consumption (the bench/
+recertify successor to ad-hoc line protocols).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("paths", nargs="+", help="run dir(s) and/or events*.jsonl")
+    p.add_argument("--json", action="store_true", help="emit summary JSON")
+    p.add_argument("--top", type=int, default=20, help="span table rows")
+    args = p.parse_args(argv)
+
+    from distributeddeeplearning_tpu.obs import report
+
+    try:
+        loaded = report.load(args.paths)
+    except FileNotFoundError as e:
+        print(f"ERROR: no event files under {e}", file=sys.stderr)
+        return 2
+    summary = report.summarize(loaded)
+    if args.json:
+        summary = dict(summary)
+        print(json.dumps(summary, default=str))
+    else:
+        print(report.render(summary, top_n=args.top))
+        # A crashed/preempted process's last moments live in its flight
+        # dump — surface their existence so nobody greps for them.
+        dumps = []
+        for path in args.paths:
+            if os.path.isdir(path):
+                dumps += sorted(glob.glob(os.path.join(path, "flight-*.jsonl")))
+        if dumps:
+            print("\nflight-recorder dumps (crash black boxes):")
+            for d in dumps:
+                with open(d) as fh:
+                    first = fh.readline()
+                try:
+                    reason = json.loads(first).get("reason", "?")
+                except json.JSONDecodeError:
+                    reason = "?"
+                print(f"  {d}  (reason: {reason})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,31 +31,33 @@ def load_events(trace_dir: str):
         return json.load(fh), paths[-1]
 
 
-def main():
-    trace_dir = sys.argv[1]
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
-    steps = int(os.environ.get("TRACE_STEPS", "20"))
-    data, path = load_events(trace_dir)
-    events = data["traceEvents"]
-
-    # pid -> process name; keep TensorCore-ish lanes (XLA ops run there).
+def device_pids(events) -> set:
+    """pid set of TensorCore/XLA-op lanes (where device ops run)."""
     proc = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "process_name":
             proc[e["pid"]] = e["args"].get("name", "")
-    device_pids = {
+    return {
         p for p, n in proc.items()
         if "TPU" in n or "Tensor" in n or "/device" in n.lower()
     }
 
+
+def summarize_trace(data):
+    """Group complete device-lane events by fusion-name prefix.
+
+    Returns ``(groups, total_ms)`` where ``groups`` maps op-group name
+    (trailing digits/dots stripped: ``fusion.123`` → ``fusion``) to
+    ``[total_ms, count]``. Envelope events (``jit_*``/``Steps*``) are
+    skipped — they'd double-count their children."""
+    events = data["traceEvents"]
+    pids = device_pids(events)
     groups = collections.defaultdict(lambda: [0.0, 0])
     total = 0.0
     for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+        if e.get("ph") != "X" or e.get("pid") not in pids:
             continue
         name = e.get("name", "")
-        # thread-level lanes include steps/modules; skip the module-level
-        # envelope events (they'd double-count their children)
         if name.startswith("jit_") or name.startswith("Steps"):
             continue
         dur = e.get("dur", 0) / 1e3  # us -> ms
@@ -63,13 +65,34 @@ def main():
         groups[key][0] += dur
         groups[key][1] += 1
         total += dur
+    return dict(groups), total
 
-    print(f"# {path}")
-    print(f"# total device op time: {total:.1f} ms "
-          f"({total / steps:.1f} ms/step over {steps} steps)")
-    print(f"{'group':55s} {'ms/step':>9s} {'count':>7s} {'%':>6s}")
-    for key, (ms, cnt) in sorted(groups.items(), key=lambda kv: -kv[1][0])[:top_n]:
-        print(f"{key:55s} {ms / steps:9.2f} {cnt:7d} {100 * ms / total:5.1f}%")
+
+def render(groups, total, steps, path, top_n=30) -> str:
+    lines = [
+        f"# {path}",
+        f"# total device op time: {total:.1f} ms "
+        f"({total / steps:.1f} ms/step over {steps} steps)",
+        f"{'group':55s} {'ms/step':>9s} {'count':>7s} {'%':>6s}",
+    ]
+    for key, (ms, cnt) in sorted(
+        groups.items(), key=lambda kv: -kv[1][0]
+    )[:top_n]:
+        lines.append(
+            f"{key:55s} {ms / steps:9.2f} {cnt:7d} "
+            f"{100 * ms / max(total, 1e-12):5.1f}%"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    trace_dir = argv[0]
+    top_n = int(argv[1]) if len(argv) > 1 else 30
+    steps = int(os.environ.get("TRACE_STEPS", "20"))
+    data, path = load_events(trace_dir)
+    groups, total = summarize_trace(data)
+    print(render(groups, total, steps, path, top_n=top_n))
 
 
 if __name__ == "__main__":

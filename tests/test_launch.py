@@ -11,6 +11,7 @@ per-process TFRecord sharding) that the in-process 8-device suite cannot.
 """
 
 import io
+import json
 import os
 import subprocess
 import sys
@@ -231,6 +232,115 @@ def test_hang_watchdog_kills_silent_world(tmp_path):
     assert res.returncode == 125, out[-2000:]
     assert "declaring the world hung" in out, out[-2000:]
     assert time.time() - t0 < 60  # watchdog fired, not the 120s timeout
+
+
+# ---------------------------------------------------------------------------
+# Observability: --obs-dir events, host-0 merge, flight recorder (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+_OBS_CHILD = textwrap.dedent(
+    """
+    import json, os, sys, time
+    from distributeddeeplearning_tpu import obs
+
+    bus = obs.configure_from_env()
+    rank = os.environ["DDL_PROCESS_ID"]
+    with bus.span("work", rank=rank):
+        time.sleep(0.05)
+    bus.counter("things", 3)
+    bus.flush()
+    bus.point("unflushed_tail")  # ring-only: the flight dump's proof
+    print("OBS_CHILD_OK", rank, flush=True)
+    if rank == "1" and os.environ.get("HANG"):
+        time.sleep(300)  # silent: the watchdog must kill us
+    """
+)
+
+
+def test_obs_run_produces_merged_events_and_report(tmp_path):
+    """The ISSUE 2 done-criterion: a 2-OS-process launch.py run writes
+    per-process events.jsonl, the launcher (host 0) merges them, and
+    scripts/obs_report.py renders a report from the run dir."""
+    script = tmp_path / "obs_child.py"
+    script.write_text(_OBS_CHILD)
+    obs_dir = tmp_path / "run1"
+    res = _run_launcher(
+        [
+            "--num-processes", "2",
+            "--obs-dir", str(obs_dir),
+            "--timeout", "120",
+            "--env", "JAX_PLATFORMS=cpu",
+            str(script),
+        ],
+        timeout=180,
+    )
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    assert "OBS_CHILD_OK 0" in out and "OBS_CHILD_OK 1" in out
+    # per-process event files + the launcher's own lifecycle file
+    assert (obs_dir / "events-p0.jsonl").exists()
+    assert (obs_dir / "events-p1.jsonl").exists()
+    assert (obs_dir / "events-launcher.jsonl").exists()
+    # host-0 merge ran at world exit
+    merged = obs_dir / "events.jsonl"
+    assert merged.exists(), out[-2000:]
+    recs = [json.loads(ln) for ln in open(merged)]
+    metas = [r for r in recs if r["kind"] == "meta"]
+    assert {str(m["p"]) for m in metas} == {"0", "1", "launcher"}
+    # one shared run id across the whole world (launcher-minted)
+    assert len({m["run"] for m in metas}) == 1
+    names = {r["name"] for r in recs if r["kind"] != "meta"}
+    assert {"rendezvous", "child_start", "child_exit", "world_exit",
+            "work", "things"} <= names
+    walls = [r["wall"] for r in recs if "wall" in r]
+    assert walls == sorted(walls)  # one consistent timeline
+
+    # ...and the report CLI renders it
+    rep = subprocess.run(
+        [sys.executable, "scripts/obs_report.py", str(obs_dir)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    assert "work" in rep.stdout and "timeline" in rep.stdout
+
+
+def test_obs_killed_child_leaves_flight_dump(tmp_path):
+    """Watchdog kill (SIGTERM) = preemption rehearsal: the hung child's
+    flight-recorder ring reaches disk with its last events — including
+    ones never flushed to the normal file — and the launcher records
+    the watchdog fire; merge still happens on the failure path."""
+    script = tmp_path / "obs_child.py"
+    script.write_text(_OBS_CHILD)
+    obs_dir = tmp_path / "run2"
+    res = _run_launcher(
+        [
+            "--num-processes", "2",
+            "--obs-dir", str(obs_dir),
+            "--hang-timeout", "6",
+            "--timeout", "120",
+            "--env", "JAX_PLATFORMS=cpu",
+            "--env", "HANG=1",
+            str(script),
+        ],
+        timeout=180,
+    )
+    out = res.stdout + res.stderr
+    assert res.returncode == 125, out[-4000:]
+    dump = obs_dir / "flight-p1.jsonl"
+    assert dump.exists(), out[-2000:]
+    recs = [json.loads(ln) for ln in open(dump)]
+    assert recs[0]["kind"] == "flight_meta"
+    assert recs[0]["reason"] == "sigterm"
+    names = [r["name"] for r in recs[1:]]
+    assert "work" in names
+    assert "unflushed_tail" in names  # the ring caught the unflushed tail
+    # launcher-side record of WHY the world died, merged and all
+    launcher_events = [
+        json.loads(ln) for ln in open(obs_dir / "events-launcher.jsonl")
+    ]
+    assert any(r.get("name") == "watchdog_fired" for r in launcher_events)
+    assert (obs_dir / "events.jsonl").exists()
 
 
 @pytest.mark.parametrize(

@@ -211,6 +211,49 @@ def test_accumulating_step_is_math_neutral(engine, mesh8):
         assert np.float32(means[k]) == want, (k, means[k], want)
 
 
+def test_sync_invariant_holds_with_event_bus_enabled(mesh8, tmp_path):
+    """ISSUE 2 hard constraint: with the event bus WRITING (OBS_DIR
+    live), instrumentation adds zero host syncs — the ≤1-per-epoch
+    invariant holds under hostsync.track(), and the captured events
+    prove the bus saw the whole run from host-side floats only."""
+    import json
+
+    from distributeddeeplearning_tpu import obs
+
+    cfg = _token_cfg("dp", epochs=2)
+    bus = obs.configure(str(tmp_path / "run"))
+    try:
+        hostsync.accountant().reset()
+        with hostsync.track():
+            res = loop.fit(
+                get_model("lm_tiny", num_classes=VOCAB, dtype="float32",
+                          max_seq_len=T),
+                cfg,
+                _token_data(cfg),
+                mesh=mesh8,
+                add_default_logger=False,
+            )
+        acct = hostsync.accountant()
+        assert acct.count == cfg.epochs, acct.by_label
+        assert acct.by_label.get("epoch_metrics") == cfg.epochs
+        assert res.perf["host_sync_count"] == cfg.epochs
+        # The bus captured the run: per-step spans, per-epoch spans, and
+        # exactly the epoch-boundary materialisations as sync counters.
+        lines = [json.loads(ln) for ln in open(bus.path)]
+        steps = [r for r in lines
+                 if r.get("kind") == "span" and r["name"] == "step"]
+        epochs = [r for r in lines
+                  if r.get("kind") == "span" and r["name"] == "epoch"]
+        syncs = [r for r in lines
+                 if r.get("kind") == "counter" and r["name"] == "host_sync"]
+        assert len(epochs) == cfg.epochs
+        assert len(steps) == cfg.epochs * _token_data(cfg).steps_per_epoch
+        assert sum(r["value"] for r in syncs) == cfg.epochs
+        assert {r["labels"]["label"] for r in syncs} == {"epoch_metrics"}
+    finally:
+        obs.reset()
+
+
 def test_warm_persistent_cache_skips_recompilation(mesh8, tmp_path):
     """(3): second AOT warmup against a warm on-disk cache observes
     cache hits; the executables really landed on disk the first time."""

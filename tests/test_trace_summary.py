@@ -1,0 +1,95 @@
+"""Tests for scripts/trace_summary.py (previously untested — ISSUE 2).
+
+A synthetic ``*.trace.json.gz`` stands in for a jax.profiler capture:
+device-lane grouping, envelope-event skipping, TRACE_STEPS
+normalisation, and the no-trace error path are all CPU-provable.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from scripts.trace_summary import (
+    load_events,
+    main as trace_main,
+    render,
+    summarize_trace,
+)
+
+
+def _trace_data():
+    """Two lanes: pid 1 is a TensorCore lane, pid 2 is host python."""
+    return {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0 TensorCore"}},
+            {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "python host"}},
+            # device ops: two fusions (grouped), one copy
+            {"ph": "X", "pid": 1, "name": "fusion.123", "dur": 2000},
+            {"ph": "X", "pid": 1, "name": "fusion.7", "dur": 1000},
+            {"ph": "X", "pid": 1, "name": "copy.1", "dur": 500},
+            # envelope events must NOT count (would double their children)
+            {"ph": "X", "pid": 1, "name": "jit_train_step", "dur": 99999},
+            {"ph": "X", "pid": 1, "name": "Steps", "dur": 99999},
+            # host-lane op must NOT count
+            {"ph": "X", "pid": 2, "name": "hostop", "dur": 5000},
+            # non-complete event on the device lane must NOT count
+            {"ph": "B", "pid": 1, "name": "fusion.9", "dur": 7000},
+        ]
+    }
+
+
+def _write_trace(dirpath, data, name="t.trace.json.gz"):
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, name)
+    with gzip.open(path, "wt") as fh:
+        json.dump(data, fh)
+    return path
+
+
+def test_grouped_per_op_totals(tmp_path):
+    path = _write_trace(str(tmp_path), _trace_data())
+    data, found = load_events(str(tmp_path))
+    assert found == path
+    groups, total = summarize_trace(data)
+    # fusion.123 + fusion.7 group under "fusion": 3.0 ms over 2 events
+    assert groups["fusion"] == [3.0, 2]
+    assert groups["copy"] == [0.5, 1]
+    assert "jit_train_step" not in groups and "Steps" not in groups
+    assert "hostop" not in groups
+    assert total == pytest.approx(3.5)
+
+
+def test_trace_steps_normalisation(tmp_path, capsys, monkeypatch):
+    """TRACE_STEPS divides the totals into ms/step in the rendered table."""
+    _write_trace(str(tmp_path), _trace_data())
+    monkeypatch.setenv("TRACE_STEPS", "2")
+    trace_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    # 3.5 ms total over 2 steps = 1.75 ms/step; fusion 3.0/2 = 1.50
+    assert "1.8 ms/step over 2 steps" in out
+    assert "1.50" in out
+    # default render math, directly: 20 steps -> 0.15 ms/step for fusion
+    groups, total = summarize_trace(_trace_data())
+    table = render(groups, total, 20, "p")
+    assert "0.15" in table
+
+
+def test_newest_trace_wins(tmp_path):
+    old = _trace_data()
+    old["traceEvents"][2]["dur"] = 1  # distinguishable
+    _write_trace(str(tmp_path), old, name="a.trace.json.gz")
+    new_path = _write_trace(str(tmp_path), _trace_data(), name="b.trace.json.gz")
+    os.utime(new_path, (2_000_000_000, 2_000_000_000))
+    data, found = load_events(str(tmp_path))
+    assert found == new_path
+    groups, _ = summarize_trace(data)
+    assert groups["fusion"] == [3.0, 2]
+
+
+def test_no_trace_errors(tmp_path):
+    with pytest.raises(SystemExit, match="no .*trace"):
+        load_events(str(tmp_path))
