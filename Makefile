@@ -21,9 +21,9 @@ SCRIPT            ?= examples/imagenet_keras_tpu.py
 JOB               ?= ddl-train
 PY                ?= python
 
-.PHONY: build login push run jupyter smoke test test-fast test-smoke \
+.PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        native provision setup submit stream status stop teardown
+        accum-memory native provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
 build:
@@ -61,6 +61,12 @@ test:	## full suite (~52 min on a 1-vCPU host; see docs/TESTING.md)
 test-fast:	## deselect the measured-heavy oracles (tests/heavy_tests.txt)
 	$(PY) -m pytest tests/ -x -q -m "not heavy"
 
+check:	## CI gate: heavy-list drift guard, then the fast tier — a new
+	## slow test that skipped tests/heavy_tests.txt fails here instead
+	## of silently bloating every fast run (scripts/heavy_refresh.py)
+	$(PY) scripts/heavy_refresh.py --check
+	$(MAKE) test-fast
+
 test-smoke:	## sub-minute loop: pure-host logic + mesh/collective semantics
 	$(PY) -m pytest tests/test_collectives.py tests/test_config.py \
 	    tests/test_timer.py tests/test_env_utils.py tests/test_schedules.py \
@@ -77,6 +83,9 @@ recertify:	## all headline protocols at one HEAD -> RECERT.json (round 5)
 
 decode-audit:	## decode-tier roofline + batch sweep (round 5)
 	$(PY) scripts/decode_audit.py
+
+accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
+	$(PY) scripts/accum_memory.py
 
 heavy-refresh:	## prune tests/heavy_tests.txt against --collect-only + print tier numbers
 	$(PY) scripts/heavy_refresh.py

@@ -44,13 +44,29 @@ def _emit_record(record: dict) -> None:
     """THE output path for every protocol record: the canonical JSON
     line on stdout (the driver's contract, unchanged) plus the same
     record as a ``bench_result`` event on the bus — ring-only when
-    events mode is off, persisted when ``--events``/``OBS_DIR`` is on."""
+    events mode is off, persisted when ``--events``/``OBS_DIR`` is on.
+    Train-protocol records carrying accumulation fields also land as
+    gauges so run reports can plot effective batch vs throughput."""
     print(json.dumps(record), flush=True)
     from distributeddeeplearning_tpu import obs
 
     bus = obs.get_bus()
     bus.point("bench_result", **record)
+    if "accum_steps" in record:
+        bus.gauge("bench.accum_steps", float(record["accum_steps"]))
+    if "effective_batch" in record:
+        bus.gauge("bench.effective_batch", float(record["effective_batch"]))
     bus.flush()
+
+
+def _accum_steps_env() -> int:
+    """ACCUM_STEPS for the bench protocols (in-step microbatched
+    accumulation — the compiled step scans k microbatches per dispatch;
+    activation memory ∝ microbatch). Resolved once so the JSON record
+    can never disagree with the program that ran."""
+    import os
+
+    return max(int(os.environ.get("ACCUM_STEPS", "1")), 1)
 
 
 def run_bench(
@@ -80,7 +96,8 @@ def run_bench(
     n_dev = devices if devices is not None else jax.device_count()
     global_batch = per_device_batch * n_dev
     cfg = TrainConfig(
-        batch_size_per_device=per_device_batch, image_size=image_size
+        batch_size_per_device=per_device_batch, image_size=image_size,
+        accum_steps=_accum_steps_env(),
     )
     # model_name (a vision-zoo registry name) measures that model under
     # the same protocol (BASELINE configs: vit_b16, efficientnet_b4);
@@ -148,6 +165,8 @@ def run_bench(
         "compile_sec": round(compile_sec, 3),
         # syncs inside the measured region: exactly the closing fence
         "host_sync_count": int(hostsync.accountant().count - sync0),
+        "accum_steps": cfg.accum_steps,
+        "effective_batch": global_batch,
     }
     return images_per_sec, n_dev, perf
 
@@ -187,6 +206,7 @@ def run_lm_bench(
         batch_size_per_device=per_device_batch,
         attn_impl=attn_impl,
         num_classes=vocab,
+        accum_steps=_accum_steps_env(),
     )
     model = get_model(model_name, **cfg.model_kwargs(), max_seq_len=seq_len)
     mesh = data_parallel_mesh(n_dev)
@@ -230,6 +250,8 @@ def run_lm_bench(
     perf = {
         "compile_sec": round(compile_sec, 3),
         "host_sync_count": int(hostsync.accountant().count - sync0),
+        "accum_steps": cfg.accum_steps,
+        "effective_batch": global_batch,
     }
     return tokens_per_sec, n_dev, perf
 
@@ -325,6 +347,8 @@ def lm_main():
                     "vs_baseline": 0.0,
                     "compile_sec": perf["compile_sec"],
                     "host_sync_count": perf["host_sync_count"],
+                    "accum_steps": perf["accum_steps"],
+                    "effective_batch": perf["effective_batch"],
                     "detail": {
                         "devices": n_dev,
                         "per_device_batch": per_device_batch,
@@ -595,6 +619,8 @@ def main():
                     else 0.0,
                     "compile_sec": perf["compile_sec"],
                     "host_sync_count": perf["host_sync_count"],
+                    "accum_steps": perf["accum_steps"],
+                    "effective_batch": perf["effective_batch"],
                     "detail": detail,
                 }
             )

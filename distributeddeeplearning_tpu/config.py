@@ -87,6 +87,15 @@ class TrainConfig:
     # mean of the last k gradients (k× the effective batch without k×
     # the memory). Works under every engine.
     grad_accum_steps: int = 1
+    # In-step microbatched accumulation (env ACCUM_STEPS): every engine's
+    # compiled step scans over k microbatches with an on-device f32
+    # gradient accumulator — activation memory scales with the MICRObatch
+    # while one host dispatch still covers one effective step (unlike
+    # grad_accum_steps above, which spends k dispatches per update).
+    # Must divide batch_size_per_device (and, under ENGINE=pp, leave each
+    # microbatch divisible by pp_microbatches) — validated with the
+    # numbers named in training/accum.validate_accum_config.
+    accum_steps: int = 1
     weight_decay: float = 5e-5
     label_smoothing: float = 0.0
     epochs: int = 1
@@ -276,6 +285,8 @@ class TrainConfig:
             kw["prefetch_batches"] = int(e["PREFETCH_BATCHES"])
         if "GRAD_ACCUM_STEPS" in e:
             kw["grad_accum_steps"] = int(e["GRAD_ACCUM_STEPS"])
+        if "ACCUM_STEPS" in e:
+            kw["accum_steps"] = int(e["ACCUM_STEPS"])
         if "WEIGHT_DECAY" in e:
             kw["weight_decay"] = float(e["WEIGHT_DECAY"])
         if "DECOUPLED_WEIGHT_DECAY" in e:

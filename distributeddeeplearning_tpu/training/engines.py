@@ -161,6 +161,14 @@ def build_engine(
     engine = config.engine
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r} (have {ENGINES})")
+    # In-step gradient accumulation (ACCUM_STEPS) divisibility — checked
+    # here, the one dispatch point, so every front-end fails with the
+    # actionable message before any compile (training/accum.py).
+    from distributeddeeplearning_tpu.training.accum import (
+        validate_accum_config,
+    )
+
+    validate_accum_config(config, mesh)
     model = adapt_model(model, engine, mesh, config)
 
     if engine == "pjit":

@@ -94,6 +94,11 @@ def resolve_engine(config, mesh=None):
         raise ValueError(
             f"unknown PP_SCHEDULE {config.pp_schedule!r} (have gpipe, 1f1b)"
         )
+    # ACCUM_STEPS sanity that needs no mesh (>= 1); divisibility against
+    # the resolved mesh is validated in engines.build_engine.
+    from distributeddeeplearning_tpu.training.accum import resolve_accum_steps
+
+    resolve_accum_steps(config)
     if mesh is None:
         # Engine-appropriate default topology when the user named an
         # engine but no mesh at all: ENGINE=pp → (data, pipe) with
@@ -273,6 +278,7 @@ def fit(
         start_epoch=start_epoch,
         steps_per_epoch=steps_per_epoch,
         devices=jax.device_count(),
+        accum_steps=getattr(train_step, "accum_steps", config.accum_steps),
     )
     metrics = {}
     for epoch in range(start_epoch, epochs):
@@ -375,11 +381,22 @@ def fit(
         hostsync.accountant().count - sync_start
     )
     perf.update(warmup_info)
+    # Effective-batch accounting: one dispatch == one optimizer step on
+    # the whole staged batch, with or without in-step accumulation —
+    # every image above was counted exactly once, and the dataset's
+    # delivered batch IS the effective batch. accum_steps only changes
+    # the in-step microbatch (global_batch / accum_steps / dp).
+    accum_steps = int(getattr(train_step, "accum_steps", config.accum_steps))
+    perf["accum_steps"] = float(accum_steps)
+    perf["effective_batch"] = float(global_batch)
     extra: Dict[str, Any] = {
         "host_sync_count": int(perf["host_sync_count"]),
         "dispatch_p50_ms": round(perf["dispatch_p50_ms"], 3),
         "dispatch_p99_ms": round(perf["dispatch_p99_ms"], 3),
     }
+    if accum_steps > 1:
+        extra["accum_steps"] = accum_steps
+        extra["effective_batch"] = int(global_batch)
     if "compile_sec" in perf:
         extra["compile_sec"] = round(perf["compile_sec"], 3)
     images_per_sec = log_summary(

@@ -20,6 +20,13 @@ same buffers for the whole epoch.
 
 ``METRIC_KEYS`` is the cross-engine metric contract: every train step
 emits exactly these scalar metrics, already reduced across the mesh.
+
+In-step gradient accumulation (``ACCUM_STEPS`` — ``training/accum.py``)
+keeps this contract intact: a microbatched step emits ONE metric sample
+per dispatch (the f32 mean over its k microbatches, with ``grad_norm``
+taken on the final mean gradient), so the epoch accumulator below still
+counts effective steps and the epoch mean stays a mean over optimizer
+updates, exactly as without accumulation.
 """
 
 from __future__ import annotations

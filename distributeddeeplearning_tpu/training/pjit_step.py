@@ -189,16 +189,28 @@ def make_pjit_train_step(
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Compiled GSPMD train step. Shardings ride in on the arguments
     (committed state + batch), so the same function serves DP, TP and
-    DP×TP meshes."""
+    DP×TP meshes.
+
+    ``config.accum_steps > 1`` compiles the microbatched variant: the
+    global batch is re-sliced into k *device-interleaved* microbatches
+    (each microbatch takes every data shard's j-th local slice — purely
+    local data movement, and the same rows per shard the dp engine's
+    split produces), scanned with an on-device f32 gradient accumulator
+    (``training/accum.py``)."""
     from distributeddeeplearning_tpu.models.sharding import (
         rules_for_mesh,
         rules_table,
     )
 
     from distributeddeeplearning_tpu.models.norm import per_replica_bn
-    from distributeddeeplearning_tpu.parallel.mesh import dp_size
+    from distributeddeeplearning_tpu.parallel.mesh import (
+        batch_axes as _mesh_batch_axes,
+        dp_size,
+    )
+    from distributeddeeplearning_tpu.training import accum
 
     cfg = config or TrainConfig()
+    accum_steps = accum.resolve_accum_steps(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed)
     batch_sharding = _mesh_batch_sharding(mesh)
     rules = list(rules_for_mesh(mesh, rules_table(cfg.param_sharding)))
@@ -259,6 +271,101 @@ def make_pjit_train_step(
         )
         return new_state, metrics
 
+    def step_microbatched(state: TrainState, batch: Batch):
+        """ACCUM_STEPS>1 (global-view): scan over device-interleaved
+        microbatches; grads/metrics mean-weighted, optimizer once."""
+        from distributeddeeplearning_tpu.data.pipeline import (
+            normalize_staged_images,
+        )
+
+        images, labels = batch
+        images = lax.with_sharding_constraint(images, batch_sharding)
+        labels = lax.with_sharding_constraint(labels, batch_sharding)
+        d = dp_size(mesh)
+        bt = _mesh_batch_axes(mesh)
+        lead = (bt if len(bt) > 1 else bt[0]) if bt else None
+        accum.check_local_divisible(
+            images.shape[0] // max(d, 1), accum_steps, dp=d, engine="pjit"
+        )
+
+        def interleave(x):
+            # [B, ...] -> [k, B/k, ...] where microbatch j concatenates
+            # every data shard's j-th local slice: reshape/transpose are
+            # local under the pinned shardings (no cross-shard traffic),
+            # and each microbatch stays sharded over all data shards.
+            b = x.shape[0]
+            x = x.reshape(d, accum_steps, b // (d * accum_steps), *x.shape[1:])
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(lead))
+            )
+            x = jnp.swapaxes(x, 0, 1)
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(None, lead))
+            )
+            x = x.reshape(accum_steps, b // accum_steps, *x.shape[3:])
+            return lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(None, lead))
+            )
+
+        xs = (interleave(images), interleave(labels))
+        step_rng = jax.random.fold_in(base_rng, state.step)
+
+        def micro(bs, mb, idx):
+            mb_images, mb_labels = mb
+
+            def loss_fn(params):
+                with mesh, nn.logical_axis_rules(rules), \
+                        per_replica_bn(bn_groups), gspmd_trace():
+                    logits, mutated = model.apply(
+                        {"params": params, "batch_stats": bs},
+                        normalize_staged_images(mb_images),
+                        train=True,
+                        mutable=["batch_stats", "losses"],
+                        rngs={
+                            "dropout": jax.random.fold_in(step_rng, idx)
+                        },
+                    )
+                loss = cross_entropy_loss(
+                    logits, mb_labels, cfg.label_smoothing
+                )
+                loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+                loss = loss + sown_aux_loss(mutated)
+                return loss, (logits, mutated.get("batch_stats", bs))
+
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            hard = (
+                jnp.argmax(mb_labels, -1)
+                if mb_labels.ndim == logits.ndim
+                else mb_labels
+            )
+            accuracy = jnp.mean(
+                (jnp.argmax(logits, -1) == hard).astype(jnp.float32)
+            )
+            return grads, {"loss": loss, "accuracy": accuracy}, new_bs
+
+        grads, micro_metrics, new_bs = accum.accumulate_microbatches(
+            micro, xs, accum_steps, state.params, extra0=state.batch_stats
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        metrics = {
+            "loss": micro_metrics["loss"],
+            "accuracy": micro_metrics["accuracy"],
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    if accum_steps > 1:
+        step = step_microbatched
+
     from distributeddeeplearning_tpu.training.metrics import (
         StepFn,
         accumulate_metrics,
@@ -273,7 +380,9 @@ def make_pjit_train_step(
     # the state are donated.
     jit2 = jax.jit(step, donate_argnums=(0,) if donate_state else ())
     jit3 = jax.jit(step_acc, donate_argnums=(0, 2) if donate_state else (2,))
-    return StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
+    wrapped = StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
+    wrapped.accum_steps = accum_steps
+    return wrapped
 
 
 def make_pjit_eval_step(

@@ -123,7 +123,10 @@ def make_pp_train_step(
         )
     if schedule != "gpipe":
         raise ValueError(f"unknown PP schedule {schedule!r} (gpipe, 1f1b)")
+    from distributeddeeplearning_tpu.training import accum as _accum_mod
+
     cfg = config or TrainConfig()
+    accum_steps = _accum_mod.resolve_accum_steps(cfg)
     if PIPE_AXIS not in mesh.axis_names:
         raise ValueError(f"mesh {mesh.axis_names} has no '{PIPE_AXIS}' axis")
     n_stages = mesh.shape[PIPE_AXIS]
@@ -200,30 +203,21 @@ def make_pp_train_step(
         h = outs.reshape(b_l, t, hidden)
         return head.apply({"params": params["head"]}, h)
 
-    def local_step(state: TrainState, batch: Batch):
-        tokens, labels = batch
+    # Replicated groups become device-varying so their grads stay
+    # per-device until OUR collectives (same rationale as
+    # train_step.py's pcast); stage params already vary over pipe but
+    # not over data.
+    def vary(tree, axes):
+        if not axes:
+            return tree
+        ax = axes if len(axes) > 1 else axes[0]
+        return jax.tree.map(lambda p: lax.pcast(p, ax, to="varying"), tree)
+
+    def chunk_grads(params_v, tokens, labels, dropout_rng):
+        """Raw (pre-collective) grads + pipe-invariant loss/accuracy for
+        one schedule pass over ``tokens`` — the unit ACCUM_STEPS scans."""
         s_idx = lax.axis_index(PIPE_AXIS)
         is_last = s_idx == S - 1
-        dropout_rng = jax.random.fold_in(
-            jax.random.fold_in(base_rng, state.step),
-            flat_axis_index(mesh, all_axes),
-        )
-
-        # Replicated groups become device-varying so their grads stay
-        # per-device until OUR collectives (same rationale as
-        # train_step.py's pcast); stage params already vary over pipe but
-        # not over data.
-        def vary(tree, axes):
-            if not axes:
-                return tree
-            ax = axes if len(axes) > 1 else axes[0]
-            return jax.tree.map(lambda p: lax.pcast(p, ax, to="varying"), tree)
-
-        params_v = {
-            "embed": vary(state.params["embed"], all_axes),
-            "stages": vary(state.params["stages"], data_axes),
-            "head": vary(state.params["head"], all_axes),
-        }
 
         def loss_fn(params):
             from distributeddeeplearning_tpu.parallel.collectives import (
@@ -256,6 +250,15 @@ def make_pp_train_step(
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params_v
         )
+        acc_local = jnp.mean(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        )
+        accuracy = lax.psum(jnp.where(is_last, acc_local, 0.0), PIPE_AXIS)
+        return grads, loss, accuracy
+
+    def finish_step(state, grads, loss, accuracy):
+        """Shared tail: pipe psums on embed/head, DP pmean, optimizer
+        update, metric reduction — identical for accumulated and plain."""
         # Embed/head: contributions live on one stage, zeros elsewhere —
         # psum over pipe restores the exact replicated grad. Stage grads
         # are per-stage by construction (never reduced over pipe).
@@ -273,11 +276,6 @@ def make_pp_train_step(
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
-
-        acc_local = jnp.mean(
-            (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
-        )
-        accuracy = lax.psum(jnp.where(is_last, acc_local, 0.0), PIPE_AXIS)
 
         def sq(tree):
             return sum(
@@ -302,6 +300,74 @@ def make_pp_train_step(
             opt_state=new_opt_state,
         )
         return new_state, metrics
+
+    def local_step(state: TrainState, batch: Batch):
+        tokens, labels = batch
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            flat_axis_index(mesh, all_axes),
+        )
+        params_v = {
+            "embed": vary(state.params["embed"], all_axes),
+            "stages": vary(state.params["stages"], data_axes),
+            "head": vary(state.params["head"], all_axes),
+        }
+        grads, loss, accuracy = chunk_grads(
+            params_v, tokens, labels, dropout_rng
+        )
+        return finish_step(state, grads, loss, accuracy)
+
+    def local_step_microbatched(state: TrainState, batch: Batch):
+        """ACCUM_STEPS>1: scan the whole schedule over k batch chunks;
+        each chunk still runs its own M-microbatch pipeline pass
+        (``training/accum.py`` for the shared scan)."""
+        from distributeddeeplearning_tpu.training import accum
+
+        tokens, labels = batch
+        dp = 1
+        for a in data_axes:
+            dp *= mesh.shape[a]
+        micro_b = accum.check_local_divisible(
+            tokens.shape[0], accum_steps, dp=dp, engine="pp"
+        )
+        if micro_b % M:
+            raise ValueError(
+                f"ENGINE=pp ACCUM_STEPS={accum_steps}: accumulation "
+                f"microbatch {micro_b} (per-shard batch {tokens.shape[0]} "
+                f"/ {accum_steps}) is not divisible by PP_MICROBATCHES={M}"
+            )
+        xs = accum.split_microbatches((tokens, labels), accum_steps)
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            flat_axis_index(mesh, all_axes),
+        )
+        params_v = {
+            "embed": vary(state.params["embed"], all_axes),
+            "stages": vary(state.params["stages"], data_axes),
+            "head": vary(state.params["head"], all_axes),
+        }
+
+        def micro(_, mb, idx):
+            mb_tokens, mb_labels = mb
+            grads, loss, accuracy = chunk_grads(
+                params_v, mb_tokens, mb_labels,
+                jax.random.fold_in(step_rng, idx),
+            )
+            return grads, {"loss": loss, "accuracy": accuracy}, None
+
+        grads, micro_metrics, _ = accum.accumulate_microbatches(
+            micro, xs, accum_steps, params_v,
+            vary=lambda t: vary(t, all_axes),
+            # loss/accuracy leave chunk_grads pipe-invariant (psum'd) but
+            # still data-varying — the metric carry must match that.
+            vary_metrics=lambda t: vary(t, data_axes),
+        )
+        return finish_step(
+            state, grads, micro_metrics["loss"], micro_metrics["accuracy"]
+        )
+
+    if accum_steps > 1:
+        local_step = local_step_microbatched
 
     from distributeddeeplearning_tpu.training.metrics import (
         StepFn,
@@ -347,6 +413,7 @@ def make_pp_train_step(
 
     step = StepFn(resolve)
     step.build = build  # AOT access (scripts/pp_schedule_bench.py)
+    step.accum_steps = accum_steps
     return step
 
 
@@ -397,7 +464,10 @@ def _make_pp_train_step_1f1b(
     are identical to the GPipe step (same objective, same collectives);
     the exact-equality oracle in ``tests/test_pp_step.py`` covers both.
     """
+    from distributeddeeplearning_tpu.training import accum as _accum_mod
+
     cfg = config or TrainConfig()
+    accum_steps = _accum_mod.resolve_accum_steps(cfg)
     if PIPE_AXIS not in mesh.axis_names:
         raise ValueError(f"mesh {mesh.axis_names} has no '{PIPE_AXIS}' axis")
     S = mesh.shape[PIPE_AXIS]
@@ -411,31 +481,23 @@ def _make_pp_train_step_1f1b(
     embed, core, head = pl.modules()
     base_rng = jax.random.PRNGKey(cfg.seed)
 
-    def local_step(state: TrainState, batch: Batch):
-        tokens, labels = batch
+    def vary(tree, axes):
+        if not axes:
+            return tree
+        ax = axes if len(axes) > 1 else axes[0]
+        return jax.tree.map(lambda p: lax.pcast(p, ax, to="varying"), tree)
+
+    def chunk_grads(params_v, stage_p, tokens, labels, dropout_rng):
+        """One full 1F1B schedule pass over ``tokens``: raw per-device
+        grads (embed/head pre-psum, stages without the leading shard
+        axis) + this chunk's masked ce/accuracy sums — the unit
+        ACCUM_STEPS scans."""
         s_idx = lax.axis_index(PIPE_AXIS)
         is_last = s_idx == S - 1
         b_l, t_len = tokens.shape
         if b_l % M:
             raise ValueError(f"local batch {b_l} not divisible by {M} microbatches")
         mb = b_l // M
-        dropout_rng = jax.random.fold_in(
-            jax.random.fold_in(base_rng, state.step),
-            flat_axis_index(mesh, all_axes),
-        )
-
-        def vary(tree, axes):
-            if not axes:
-                return tree
-            ax = axes if len(axes) > 1 else axes[0]
-            return jax.tree.map(lambda p: lax.pcast(p, ax, to="varying"), tree)
-
-        params_v = {
-            "embed": vary(state.params["embed"], all_axes),
-            "stages": vary(state.params["stages"], data_axes),
-            "head": vary(state.params["head"], all_axes),
-        }
-        stage_p = jax.tree.map(lambda a: a[0], params_v["stages"])
 
         # Embedding forward under vjp — its backward runs after the scan
         # on the accumulated stage-0 input gradients.
@@ -541,13 +603,26 @@ def _make_pp_train_step_1f1b(
             tick, carry0, jnp.arange(M + 2 * S - 1)
         )
 
-        # Embedding backward + cross-stage reductions (zeros off-owner).
+        # Embedding backward (zeros off-owner); cross-stage reductions
+        # happen in finish_step, once, on the (possibly accumulated) raw
+        # grads.
         (dembed,) = embed_vjp(dx_all.reshape(b_l, t_len, hidden))
+        raw = {"embed": dembed, "stages": sgrad, "head": hgrad}
+        return raw, ce_sum, acc_sum
+
+    def finish_step(state, params_v, stage_p, raw, ce_sum, acc_sum):
+        """Shared tail (plain and ACCUM_STEPS>1): pipe psums, closed-form
+        L2, DP pmean, optimizer update, metric reduction."""
+        s_idx = lax.axis_index(PIPE_AXIS)
         grads = {
-            "embed": jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), dembed),
+            "embed": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), raw["embed"]
+            ),
             # restore the leading [1, ...] local-shard stage axis
-            "stages": jax.tree.map(lambda g: g[None], sgrad),
-            "head": jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), hgrad),
+            "stages": jax.tree.map(lambda g: g[None], raw["stages"]),
+            "head": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), raw["head"]
+            ),
         }
         # L2 objective term, in closed form (same masked-psum semantics
         # as the GPipe step's AD: embed/head counted once, stages
@@ -600,6 +675,78 @@ def _make_pp_train_step_1f1b(
         )
         return new_state, metrics
 
+    def _params_v(state):
+        params_v = {
+            "embed": vary(state.params["embed"], all_axes),
+            "stages": vary(state.params["stages"], data_axes),
+            "head": vary(state.params["head"], all_axes),
+        }
+        return params_v, jax.tree.map(lambda a: a[0], params_v["stages"])
+
+    def local_step(state: TrainState, batch: Batch):
+        tokens, labels = batch
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            flat_axis_index(mesh, all_axes),
+        )
+        params_v, stage_p = _params_v(state)
+        raw, ce_sum, acc_sum = chunk_grads(
+            params_v, stage_p, tokens, labels, dropout_rng
+        )
+        return finish_step(state, params_v, stage_p, raw, ce_sum, acc_sum)
+
+    def local_step_microbatched(state: TrainState, batch: Batch):
+        """ACCUM_STEPS>1: scan whole 1F1B passes over k batch chunks;
+        the 2S-deep ring buffer (and thus activation memory) belongs to
+        ONE chunk at a time."""
+        tokens, labels = batch
+        dp = 1
+        for a in data_axes:
+            dp *= mesh.shape[a]
+        micro_b = _accum_mod.check_local_divisible(
+            tokens.shape[0], accum_steps, dp=dp, engine="pp"
+        )
+        if micro_b % M:
+            raise ValueError(
+                f"ENGINE=pp ACCUM_STEPS={accum_steps}: accumulation "
+                f"microbatch {micro_b} (per-shard batch {tokens.shape[0]} "
+                f"/ {accum_steps}) is not divisible by PP_MICROBATCHES={M}"
+            )
+        xs = _accum_mod.split_microbatches((tokens, labels), accum_steps)
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            flat_axis_index(mesh, all_axes),
+        )
+        params_v, stage_p = _params_v(state)
+        grads_like = {
+            "embed": params_v["embed"],
+            "stages": stage_p,
+            "head": params_v["head"],
+        }
+
+        def micro(_, mb, idx):
+            mb_tokens, mb_labels = mb
+            raw, ce_sum, acc_sum = chunk_grads(
+                params_v, stage_p, mb_tokens, mb_labels,
+                jax.random.fold_in(step_rng, idx),
+            )
+            return raw, {"loss": ce_sum, "accuracy": acc_sum}, None
+
+        raw, micro_metrics, _ = _accum_mod.accumulate_microbatches(
+            micro, xs, accum_steps, grads_like,
+            # ce/acc sums here are still pipe-MASKED (psum over pipe
+            # happens once in finish_step), so the metric carry varies
+            # over every axis, like the grads.
+            vary=lambda t: vary(t, all_axes),
+        )
+        return finish_step(
+            state, params_v, stage_p, raw,
+            micro_metrics["loss"], micro_metrics["accuracy"],
+        )
+
+    if accum_steps > 1:
+        local_step = local_step_microbatched
+
     from distributeddeeplearning_tpu.training.metrics import (
         StepFn,
         accumulate_metrics,
@@ -644,6 +791,7 @@ def _make_pp_train_step_1f1b(
 
     step = StepFn(resolve)
     step.build = build  # AOT access (scripts/pp_schedule_bench.py)
+    step.accum_steps = accum_steps
     return step
 
 

@@ -56,8 +56,16 @@ def make_sp_train_step(
     donate_state: bool = True,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Compiled DP×SP train step; ``model`` must be built with
-    ``attn_impl="ring"`` and ``seq_axis=seq_axis``."""
+    ``attn_impl="ring"`` and ``seq_axis=seq_axis``.
+
+    ``config.accum_steps > 1`` scans the step over k per-shard batch
+    microbatches (the sequence axis stays fully resident — only the
+    batch dim splits) with an on-device f32 gradient accumulator
+    (``training/accum.py``)."""
+    from distributeddeeplearning_tpu.training import accum
+
     cfg = config or TrainConfig()
+    accum_steps = accum.resolve_accum_steps(cfg)
     if getattr(model, "seq_axis", None) != seq_axis:
         raise ValueError(
             f"model.seq_axis={getattr(model, 'seq_axis', None)!r} must equal "
@@ -135,6 +143,86 @@ def make_sp_train_step(
             metrics,
         )
 
+    def local_step_microbatched(state: TrainState, batch: Batch):
+        """ACCUM_STEPS>1: scan over per-shard batch microbatches; grad
+        pmean over (data, seq) runs once on the accumulated mean."""
+        tokens, labels = batch
+        global_t = tokens.shape[1] * mesh.shape[seq_axis]
+        max_len = getattr(model, "max_seq_len", None)
+        if max_len is not None and global_t > max_len:
+            raise ValueError(
+                f"global sequence {global_t} (local {tokens.shape[1]} x "
+                f"{mesh.shape[seq_axis]} shards) exceeds model.max_seq_len "
+                f"{max_len}"
+            )
+        accum.check_local_divisible(
+            tokens.shape[0], accum_steps,
+            dp=mesh.shape[data_axis], engine="sp",
+        )
+        xs = accum.split_microbatches((tokens, labels), accum_steps)
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step), flat_axis_index(mesh, axes)
+        )
+        params_v = jax.tree.map(
+            lambda p: lax.pcast(p, axes, to="varying"), state.params
+        )
+
+        def micro(_, mb, idx):
+            mb_tokens, mb_labels = mb
+
+            def loss_fn(params):
+                logits, mutated = model.apply(
+                    {"params": params},
+                    mb_tokens,
+                    train=True,
+                    mutable=["losses"],
+                    rngs={"dropout": jax.random.fold_in(step_rng, idx)},
+                )
+                loss = cross_entropy_loss(
+                    logits, mb_labels, cfg.label_smoothing
+                )
+                loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+                loss = loss + sown_aux_loss(mutated)
+                return loss, logits
+
+            (loss, logits), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params_v)
+            accuracy = jnp.mean(
+                (jnp.argmax(logits, -1) == mb_labels).astype(jnp.float32)
+            )
+            return grads, {"loss": loss, "accuracy": accuracy}, None
+
+        def vary(tree):
+            return jax.tree.map(
+                lambda x: lax.pcast(x, axes, to="varying"), tree
+            )
+
+        grads, micro_metrics, _ = accum.accumulate_microbatches(
+            micro, xs, accum_steps, params_v, vary=vary
+        )
+        grads = lax.pmean(grads, axes)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        metrics = lax.pmean(
+            {
+                "loss": micro_metrics["loss"],
+                "accuracy": micro_metrics["accuracy"],
+                "grad_norm": optax.global_norm(grads),
+            },
+            axes,
+        )
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt_state
+            ),
+            metrics,
+        )
+
+    if accum_steps > 1:
+        local_step = local_step_microbatched
+
     from distributeddeeplearning_tpu.training.metrics import (
         StepFn,
         accumulate_metrics,
@@ -163,7 +251,9 @@ def make_sp_train_step(
     jit3 = jax.jit(
         sharded_acc, donate_argnums=(0, 2) if donate_state else (2,)
     )
-    return StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
+    step = StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
+    step.accum_steps = accum_steps
+    return step
 
 
 def make_sp_eval_step(

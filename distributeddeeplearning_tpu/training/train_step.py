@@ -243,10 +243,20 @@ def make_train_step(
     accumulating variant the training loop runs (metric sums build up
     on device; ``acc`` is donated).
 
+    ``config.accum_steps > 1`` compiles the microbatched step instead:
+    a ``lax.scan`` over k per-shard microbatches with an on-device f32
+    gradient accumulator, one optimizer update per dispatch — activation
+    memory ∝ microbatch, same dispatch/sync contract (``training/
+    accum.py``). BatchNorm statistics become ghost-batch (per-microbatch,
+    folded sequentially into the running stats).
+
     ``check_vma=None`` auto-resolves: on except for interpreter-mode
     Pallas attention (see :func:`_pallas_interpreted`).
     """
+    from distributeddeeplearning_tpu.training import accum
+
     cfg = config or TrainConfig()
+    accum_steps = accum.resolve_accum_steps(cfg)
     if check_vma is None:
         check_vma = not _pallas_interpreted(model)
     axes = batch_axes(mesh)
@@ -337,6 +347,102 @@ def make_train_step(
         )
         return new_state, metrics
 
+    def local_step_microbatched(state: TrainState, batch: Batch):
+        """ACCUM_STEPS>1: the same step math, scanned over k per-shard
+        microbatches — grads accumulate in f32 on device, the optimizer
+        applies their mean once, BN running stats fold per microbatch
+        (ghost batch norm). Collectives (grad/stat pmean) run ONCE on
+        the accumulated means, exactly where the plain step runs them."""
+        images, labels = batch
+        dp = 1
+        for a in axes:
+            dp *= mesh.shape[a]
+        accum.check_local_divisible(
+            images.shape[0], accum_steps, dp=dp, engine="dp"
+        )
+        xs = accum.split_microbatches((images, labels), accum_steps)
+        # Per-step, per-device base key as in the plain step; each
+        # microbatch folds its index in for independent dropout noise.
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step), _device_index()
+        )
+        params_v = jax.tree.map(
+            lambda p: lax.pcast(p, axis, to="varying"), state.params
+        )
+
+        def micro(bs, mb, idx):
+            mb_images, mb_labels = mb
+            from distributeddeeplearning_tpu.data.pipeline import (
+                normalize_staged_images,
+            )
+
+            def loss_fn(params):
+                logits, mutated = model.apply(
+                    {"params": params, "batch_stats": bs},
+                    # normalize INSIDE the scan body: the staged (possibly
+                    # uint8) batch is the only full-batch buffer alive;
+                    # the normalized copy exists per microbatch.
+                    normalize_staged_images(mb_images),
+                    train=True,
+                    mutable=["batch_stats", "losses"],
+                    rngs={"dropout": jax.random.fold_in(step_rng, idx)},
+                )
+                loss = cross_entropy_loss(
+                    logits, mb_labels, cfg.label_smoothing
+                )
+                loss = loss + l2_kernel_penalty(params, cfg.weight_decay)
+                loss = loss + sown_aux_loss(mutated)
+                return loss, (logits, mutated.get("batch_stats", bs))
+
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params_v)
+            hard = (
+                jnp.argmax(mb_labels, -1)
+                if mb_labels.ndim == logits.ndim
+                else mb_labels
+            )
+            accuracy = jnp.mean(
+                (jnp.argmax(logits, -1) == hard).astype(jnp.float32)
+            )
+            return grads, {"loss": loss, "accuracy": accuracy}, new_bs
+
+        def vary(tree):
+            return jax.tree.map(
+                lambda x: lax.pcast(x, axis, to="varying"), tree
+            )
+
+        grads, micro_metrics, new_bs = accum.accumulate_microbatches(
+            micro,
+            xs,
+            accum_steps,
+            params_v,
+            extra0=state.batch_stats,
+            vary=vary,
+        )
+        grads = _pmean_batch(grads)
+        new_bs = _pmean_batch(new_bs)  # keep replicated state invariant
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        metrics = _pmean_batch(
+            {
+                "loss": micro_metrics["loss"],
+                "accuracy": micro_metrics["accuracy"],
+                "grad_norm": optax.global_norm(grads),
+            }
+        )
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    if accum_steps > 1:
+        local_step = local_step_microbatched
+
     from distributeddeeplearning_tpu.training.metrics import (
         StepFn,
         accumulate_metrics,
@@ -369,7 +475,9 @@ def make_train_step(
     jit3 = jax.jit(
         sharded_acc, donate_argnums=(0, 2) if donate_state else (2,)
     )
-    return StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
+    step = StepFn(lambda state, with_acc: jit3 if with_acc else jit2)
+    step.accum_steps = accum_steps
+    return step
 
 
 def eval_metrics_fn(

@@ -147,8 +147,18 @@ def warmup_engine(
     info: Dict[str, float] = {}
 
     step = eng.train_step
+    # The outer AOT signature is unchanged by in-step accumulation (the
+    # [k, micro_b, ...] reshape and the f32 grad accumulator live inside
+    # the compiled program), but the program itself differs per
+    # accum_steps — report which variant was compiled.
+    accum_steps = int(getattr(step, "accum_steps", 1))
+    if accum_steps > 1:
+        info["accum_steps"] = float(accum_steps)
     if hasattr(step, "aot_compile"):
-        with obs.span("compile", what="train_step", engine=eng.name):
+        with obs.span(
+            "compile", what="train_step", engine=eng.name,
+            accum_steps=accum_steps,
+        ):
             compiled, secs = step.aot_compile(eng.state, batch, acc)
         info["train_compile_sec"] = secs
         flops = cost_analysis_flops(compiled)
@@ -167,8 +177,9 @@ def warmup_engine(
     )
     flops = info.get("train_flops_per_step")
     log.info(
-        "warmup(%s): compiled in %.2fs%s (persistent cache: %d hit, %d miss)",
+        "warmup(%s%s): compiled in %.2fs%s (persistent cache: %d hit, %d miss)",
         eng.name,
+        f", accum_steps={accum_steps}" if accum_steps > 1 else "",
         info["compile_sec"],
         f", {flops / 1e9:.2f} GFLOP/step" if flops else "",
         hits1 - hits0,

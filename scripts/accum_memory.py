@@ -1,0 +1,182 @@
+"""Host-side memory proof for in-step gradient accumulation.
+
+The claim ACCUM_STEPS exists to make true — *compiled activation memory
+scales with the microbatch, not the effective batch* — is certifiable
+without any accelerator: XLA's ``compiled.memory_analysis()`` reports
+the temp (activation/workspace) allocation of the exact program a TPU
+would run, and the CPU backend computes it at full batch sizes in
+seconds-to-minutes of compile time with zero execution.
+
+For each requested ``accum_steps`` this script AOT-compiles the dp
+engine's train step against an abstract (ShapeDtypeStruct — nothing is
+materialised) global batch and tabulates:
+
+* ``temp_bytes``   — XLA temp allocation: activations + workspace, the
+  number that caps per-chip batch on HBM;
+* ``arg_bytes`` / ``out_bytes`` — parameter+input / output buffers
+  (invariant in ``accum_steps`` — the accumulator is scan-local);
+* the per-leaf eval_shape of the staged batch (what the host ships).
+
+Usage::
+
+    python scripts/accum_memory.py                     # resnet50 b=256
+    python scripts/accum_memory.py --model vit_b16 --batch 256
+    python scripts/accum_memory.py --model lm_small --batch 8 --seq-len 1024
+    python scripts/accum_memory.py --accum 1,2,4,8 --json
+
+The markdown table is what PROFILE.md's "Microbatched accumulation"
+subsection records; the on-chip step-time A/B rides the recertify
+battery (``resnet50_accum4``) when hardware returns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_step(model_name: str, batch: int, accum_steps: int,
+               image_size: int, seq_len: int, vocab: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel.mesh import data_parallel_mesh
+    from distributeddeeplearning_tpu.training import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+    from distributeddeeplearning_tpu.training.train_step import replicate_state
+
+    is_lm = model_name.startswith("lm_")
+    cfg = TrainConfig(
+        model=model_name,
+        batch_size_per_device=batch,
+        image_size=image_size,
+        compute_dtype=dtype,
+        num_classes=vocab if is_lm else 1000,
+        accum_steps=accum_steps,
+    )
+    mesh = data_parallel_mesh(1)  # one chip's view: the HBM question
+    tx, _ = create_optimizer(cfg, steps_per_epoch=64)
+    kw = dict(num_classes=cfg.num_classes, dtype=cfg.compute_dtype)
+    if is_lm:
+        model = get_model(model_name, **kw, max_seq_len=seq_len)
+        state = create_train_state(
+            model, cfg, tx, input_shape=(1, seq_len), input_dtype=jnp.int32
+        )
+        batch_struct = (
+            jax.ShapeDtypeStruct((batch, seq_len), jnp.int32),
+            jax.ShapeDtypeStruct((batch, seq_len), jnp.int32),
+        )
+    else:
+        model = get_model(model_name, **kw)
+        state = create_train_state(model, cfg, tx)
+        batch_struct = (
+            jax.ShapeDtypeStruct(
+                (batch, image_size, image_size, 3),
+                jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+            ),
+            jax.ShapeDtypeStruct((batch,), jnp.int32),
+        )
+    state = replicate_state(state, mesh)
+    step = make_train_step(model, tx, mesh, cfg)
+    return step, state, batch_struct
+
+
+def measure(model_name: str, batch: int, accum_steps: int, *,
+            image_size: int, seq_len: int, vocab: int, dtype: str) -> dict:
+    import time
+
+    step, state, batch_struct = build_step(
+        model_name, batch, accum_steps, image_size, seq_len, vocab, dtype
+    )
+    t0 = time.perf_counter()
+    compiled = step.lower(state, batch_struct).compile()
+    compile_sec = time.perf_counter() - t0
+    ma = compiled.memory_analysis()
+    row = {
+        "accum_steps": accum_steps,
+        "micro_batch": batch // accum_steps,
+        "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+        "arg_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+        "out_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+        "compile_sec": round(compile_sec, 1),
+    }
+    return row
+
+
+def _mb(n: int) -> str:
+    return f"{n / 1e6:,.1f}"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch", type=int, default=256,
+                   help="effective (per-chip) batch — constant across rows")
+    p.add_argument("--accum", default="1,2,4,8",
+                   help="comma-separated ACCUM_STEPS values")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    ks = [int(s) for s in args.accum.split(",") if s.strip()]
+    rows = []
+    for k in ks:
+        if args.batch % k:
+            print(f"# skipping accum_steps={k}: does not divide batch "
+                  f"{args.batch}", file=sys.stderr)
+            continue
+        rows.append(
+            measure(
+                args.model, args.batch, k,
+                image_size=args.image_size, seq_len=args.seq_len,
+                vocab=args.vocab, dtype=args.dtype,
+            )
+        )
+        print(f"# accum_steps={k}: temp {_mb(rows[-1]['temp_bytes'])} MB "
+              f"(compiled in {rows[-1]['compile_sec']}s)", file=sys.stderr)
+
+    out = {
+        "model": args.model,
+        "batch": args.batch,
+        "dtype": args.dtype,
+        "platform": "cpu-hlo",  # the HLO is backend-shaped on CPU; the
+        # on-chip numbers come from the recertify battery on hardware
+        "rows": rows,
+    }
+    if args.json:
+        print(json.dumps(out, indent=1))
+        return 0
+    base = rows[0]["temp_bytes"] if rows else 1
+    print(f"\n{args.model} effective batch {args.batch} ({args.dtype}) — "
+          "compiled memory vs ACCUM_STEPS (CPU-lowered HLO)\n")
+    print("| accum_steps | microbatch | temp (activations) MB | vs k=1 | "
+          "args MB | outputs MB |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| {r['accum_steps']} | {r['micro_batch']} | "
+            f"{_mb(r['temp_bytes'])} | "
+            f"{r['temp_bytes'] / base:.2f}x | {_mb(r['arg_bytes'])} | "
+            f"{_mb(r['out_bytes'])} |"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

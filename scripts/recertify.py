@@ -35,6 +35,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PROTOCOLS = {
     "resnet50": {"BENCH_BATCH": "256"},
+    # In-step gradient accumulation A/B at the bench batch: one dispatch
+    # scans 4 microbatches of 64 — certifies the on-chip cost of the
+    # ACCUM_STEPS scan the moment hardware returns (PROFILE.md carries
+    # the host-side memory proof meanwhile). Shares the battery's
+    # compilation cache with the plain resnet50 row SAFELY: ACCUM_STEPS
+    # changes the lowered HLO (the scan + accumulator), so the XLA
+    # persistent-cache key — a hash of the HLO module — cannot collide
+    # between rows that differ only in this env var (guarded by
+    # tests/test_grad_accum.py::test_accum_changes_compiled_program).
+    "resnet50_accum4": {"BENCH_BATCH": "256", "ACCUM_STEPS": "4"},
     "vit_b16": {"BENCH_MODEL": "vit_b16", "BENCH_BATCH": "256"},
     "efficientnet_b4": {"BENCH_MODEL": "efficientnet_b4", "BENCH_BATCH": "64"},
     "lm_small_1k": {
@@ -54,8 +64,21 @@ PROTOCOLS = {
 }
 
 
+# Every var a protocol row may define: ambient values are dropped before
+# a row's own env applies, so an exported BENCH_MODEL/ACCUM_STEPS can
+# never leak into rows that deliberately leave it unset (the rows are
+# the protocol — the environment only supplies infra knobs like
+# COMPILATION_CACHE_DIR/JAX_PLATFORMS).
+_PROTOCOL_VARS = (
+    "BENCH_MODEL", "BENCH_BATCH", "BENCH_SEQ_LEN", "BENCH_DECODE",
+    "BENCH_DEPTH", "BENCH_IMAGE_SIZE", "BENCH_SCALING", "ACCUM_STEPS",
+)
+
+
 def run_protocol(name: str, env_over: dict, timeout_s: float) -> dict:
     env = dict(os.environ)
+    for var in _PROTOCOL_VARS:
+        env.pop(var, None)
     env.update(env_over)
     # One persistent compilation cache across the whole battery (and
     # across re-runs at the same commit): every protocol subprocess

@@ -1,3 +1,5 @@
+import pytest
+
 from distributeddeeplearning_tpu.config import TrainConfig, _str_to_bool
 
 
@@ -59,3 +61,47 @@ def test_env_contract():
 def test_overrides_beat_env():
     c = TrainConfig.from_env({"EPOCHS": "3"}, epochs=7)
     assert c.epochs == 7
+
+
+def test_accum_steps_env_contract():
+    c = TrainConfig.from_env({"ACCUM_STEPS": "4"})
+    assert c.accum_steps == 4
+    assert TrainConfig().accum_steps == 1  # default: no accumulation
+    # ACCUM_STEPS (in-step scan) and GRAD_ACCUM_STEPS (multi-dispatch
+    # MultiSteps) are independent knobs
+    c2 = TrainConfig.from_env({"ACCUM_STEPS": "2", "GRAD_ACCUM_STEPS": "3"})
+    assert c2.accum_steps == 2 and c2.grad_accum_steps == 3
+
+
+def test_accum_steps_validation_names_the_numbers():
+    from distributeddeeplearning_tpu.training.accum import (
+        resolve_accum_steps,
+        validate_accum_config,
+    )
+
+    with pytest.raises(ValueError, match=">= 1"):
+        resolve_accum_steps(TrainConfig(accum_steps=0))
+    # per-shard batch not divisible: message names global batch, shard
+    # count, per-shard batch, and the offending accum_steps
+    cfg = TrainConfig(batch_size_per_device=6, accum_steps=4)
+    with pytest.raises(ValueError) as ei:
+        validate_accum_config(cfg)
+    msg = str(ei.value)
+    assert "6" in msg and "ACCUM_STEPS=4" in msg and "shard" in msg
+    # valid split passes and returns k
+    assert validate_accum_config(
+        TrainConfig(batch_size_per_device=8, accum_steps=4)
+    ) == 4
+    # ENGINE=pp: each accumulation microbatch must still split into
+    # pp_microbatches pipeline microbatches
+    pp = TrainConfig(
+        engine="pp", batch_size_per_device=8, accum_steps=4,
+        pp_microbatches=4, pp_stages=4,
+    )
+    with pytest.raises(ValueError, match="PP_MICROBATCHES"):
+        validate_accum_config(pp)
+    ok = TrainConfig(
+        engine="pp", batch_size_per_device=16, accum_steps=2,
+        pp_microbatches=4, pp_stages=4,
+    )
+    assert validate_accum_config(ok) == 2
