@@ -23,7 +23,8 @@ PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        accum-memory native provision setup submit stream status stop teardown
+        accum-memory fault-suite native provision setup submit stream status \
+        stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
 build:
@@ -89,6 +90,12 @@ accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROF
 
 heavy-refresh:	## prune tests/heavy_tests.txt against --collect-only + print tier numbers
 	$(PY) scripts/heavy_refresh.py
+
+fault-suite:	## fast fault-injection battery: plan grammar, supervisor e2e,
+	## heartbeat, NaN guard, checkpoint keying + corrupt-latest fallback
+	## (the heavy resume-equivalence oracles run with the full suite)
+	$(PY) -m pytest tests/test_faults.py tests/test_fault_tolerance.py \
+	    -x -q -m "not heavy"
 
 # Render the observability report for the most recent run directory
 # (OBS_RUN=dir overrides; runs land under runs/ by convention — the

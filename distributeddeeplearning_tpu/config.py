@@ -168,7 +168,25 @@ class TrainConfig:
     seed: int = 42  # reference _SEED=42 (PyTorch :274-277, TF fake data :284)
     model_dir: Optional[str] = None  # AZ_BATCHAI_OUTPUT_MODEL equivalent
     checkpoint_every_epochs: int = 1
-    resume: bool = True
+    # Step-granular checkpointing (env CHECKPOINT_EVERY_STEPS; 0 = epoch
+    # boundaries only): save every k optimizer steps so a preemption
+    # loses minutes, not an epoch. Checkpoint keys become global step
+    # counts and resume re-enters mid-epoch, skipping the completed
+    # batches (docs/ROBUSTNESS.md). Each due save materialises the state
+    # (a deliberate host sync — durability traded against the sync-free
+    # loop; the ≤1-sync/epoch contract applies at k=0).
+    checkpoint_every_steps: int = 0
+    # env CHECKPOINT_ASYNC (default on): off makes every save durable
+    # before it returns — what the deterministic fault oracles need so
+    # "killed after step N" implies "checkpoint N committed".
+    checkpoint_async: bool = True
+    resume: bool = True  # env RESUME (the supervisor re-asserts it)
+    # On-device non-finite-loss guard (env NONFINITE_ACTION): the metric
+    # accumulator counts NaN/Inf-loss steps on device (zero extra host
+    # syncs); at the epoch boundary "abort" raises faults.
+    # NonFiniteLossError (exit 121, supervisor-non-retryable), "warn"
+    # logs and continues, "off" ignores the counter.
+    nonfinite_action: str = "abort"
     log_every_steps: int = 100  # PyTorch logs per-100-steps (:219-221)
 
     def model_kwargs(self) -> dict:
@@ -318,6 +336,16 @@ class TrainConfig:
             kw["aot_warmup"] = _str_to_bool(e["AOT_WARMUP"])
         if "SEED" in e:
             kw["seed"] = int(e["SEED"])
+        # Robustness contract (docs/ROBUSTNESS.md): step-granular
+        # checkpointing, save durability, resume toggle, NaN guard.
+        if "CHECKPOINT_EVERY_STEPS" in e:
+            kw["checkpoint_every_steps"] = int(e["CHECKPOINT_EVERY_STEPS"])
+        if "CHECKPOINT_ASYNC" in e:
+            kw["checkpoint_async"] = _str_to_bool(e["CHECKPOINT_ASYNC"])
+        if "RESUME" in e:
+            kw["resume"] = _str_to_bool(e["RESUME"])
+        if "NONFINITE_ACTION" in e:
+            kw["nonfinite_action"] = e["NONFINITE_ACTION"]
         # Smoke-test knobs (not in the reference contract): shrink the
         # problem so the identical code path runs fast on CPU.
         if "IMAGE_SIZE" in e:

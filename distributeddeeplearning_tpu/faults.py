@@ -1,0 +1,314 @@
+"""Failure taxonomy + deterministic fault injection — the robustness tier.
+
+The reference has zero failure handling: a dead Horovod rank or a
+preempted VM loses the run (SURVEY.md §5 "Failure detection: absent" —
+no retries, no resume in the PyTorch path at all). This module is the
+shared vocabulary the fault-tolerance layer speaks:
+
+* **Exit-code taxonomy** — one table mapping a dead world's exit code to
+  *retryable or not* (:func:`classify_exit`). The launcher's restart
+  supervisor (``launch.launch_supervised``) consults it before burning a
+  restart: a hang (125) or a signal death (preemption, OOM-kill) is
+  worth a resume; a non-finite loss (:data:`EXIT_NONFINITE`) would
+  deterministically recur from the same checkpoint and is not.
+* **Fault plan** — ``FAULT_PLAN`` env grammar (:func:`parse_fault_plan`)
+  describing *deterministic, step-indexed* faults: SIGKILL process k
+  after step N, SIGTERM preemption, silent hang, NaN-poisoned batch,
+  plain exit. The training loop consults a :class:`FaultInjector` at
+  step boundaries, so the same plan reproduces the same failure on
+  every run — the substrate of the resume-equivalence oracles.
+* **Checkpoint corruption** (:func:`corrupt_latest_checkpoint`) — the
+  partial-write fault a preemption mid-save leaves behind, used to
+  drive ``CheckpointManager``'s fall-back-to-previous-valid path.
+
+Everything except batch poisoning stays off the jax runtime (no device
+work, no backend init), so the launcher and the jax-light e2e children
+consult plans and classify exits for free.
+
+Fault-plan grammar (``docs/ROBUSTNESS.md``)::
+
+    FAULT_PLAN  := directive (";" directive)*
+    directive   := kind ":" key "=" value ("," key "=" value)*
+    kind        := kill | term | hang | nan | exit
+    keys        := step (required, int: fires once N optimizer steps
+                   have completed — after the step's checkpoint, if due)
+                   rank (optional int; default: every process)
+                   secs (hang only, default 3600)
+                   code (exit only, default 1)
+
+    FAULT_PLAN="kill:step=3,rank=1"          # SIGKILL process 1 after step 3
+    FAULT_PLAN="term:step=5;nan:step=2"      # SIGTERM all after 5; NaN batch 3
+
+``nan`` poisons the *next* batch (the one whose dispatch makes
+``step+1`` complete) by multiplying its float leaves with NaN — the
+loss goes non-finite and the on-device guard trips at the epoch
+boundary. Integer-only batches (token LMs) cannot carry a NaN; ``nan``
+faults are for the float-input pipelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from distributeddeeplearning_tpu import obs
+
+# ---------------------------------------------------------------------------
+# Exit-code taxonomy (docs/ROBUSTNESS.md)
+# ---------------------------------------------------------------------------
+
+EXIT_OK = 0
+#: Non-finite loss guard tripped (training/loop.py). Non-retryable: the
+#: run is deterministic, so resuming from the last checkpoint replays
+#: the same batches into the same NaN.
+EXIT_NONFINITE = 121
+#: Launcher wall-clock budget exhausted (``--timeout``). Non-retryable:
+#: the budget is spent; restarting would overshoot it again.
+EXIT_TIMEOUT = 124
+#: Hang watchdog fired (no child output for ``--hang-timeout``).
+#: Retryable: a wedged collective after a transient network/host blip
+#: is exactly what a teardown + resume fixes.
+EXIT_HUNG = 125
+#: Operator interrupt (Ctrl-C). Non-retryable: the human asked to stop.
+EXIT_INTERRUPTED = 130
+
+
+@dataclasses.dataclass(frozen=True)
+class ExitClass:
+    """Verdict for one world exit code."""
+
+    rc: int
+    retryable: bool
+    reason: str
+
+
+def classify_exit(rc: int) -> ExitClass:
+    """Map a world exit code onto the restart policy (one table, used by
+    the supervisor and printed by ``scripts/faultgen.py exit-codes``)."""
+    if rc == EXIT_OK:
+        return ExitClass(rc, False, "success")
+    if rc == EXIT_NONFINITE:
+        return ExitClass(rc, False, "nonfinite_loss")
+    if rc == EXIT_TIMEOUT:
+        return ExitClass(rc, False, "timeout_budget_exhausted")
+    if rc == EXIT_INTERRUPTED:
+        return ExitClass(rc, False, "interrupted")
+    if rc == EXIT_HUNG:
+        return ExitClass(rc, True, "world_hung")
+    if rc < 0:
+        # subprocess convention: -N = died on signal N (SIGKILL
+        # preemption, OOM-kill, segfault) — the canonical retryable case.
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = str(-rc)
+        return ExitClass(rc, True, f"signal_{name}")
+    return ExitClass(rc, True, f"crash_rc_{rc}")
+
+
+def normalize_rc(rc: int) -> int:
+    """Shell-presentable exit code: signal deaths (-N) become 128+N, the
+    POSIX convention, so the supervisor's own exit status round-trips."""
+    return 128 - rc if rc < 0 else rc
+
+
+class NonFiniteLossError(SystemExit):
+    """Raised by the training loop when the on-device non-finite guard
+    trips. A ``SystemExit`` subclass carrying :data:`EXIT_NONFINITE`, so
+    an un-caught escape exits the process with the distinct code the
+    supervisor classifies as non-retryable."""
+
+    def __init__(self, epoch: int, steps: int):
+        super().__init__(EXIT_NONFINITE)
+        self.epoch = epoch
+        self.nonfinite_steps = steps
+
+    def __str__(self) -> str:  # SystemExit.__str__ would print the code
+        return (
+            f"non-finite loss in {self.nonfinite_steps} step(s) of epoch "
+            f"{self.epoch} (exit {EXIT_NONFINITE}, non-retryable)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fault plan
+# ---------------------------------------------------------------------------
+
+FAULT_KINDS = ("kill", "term", "hang", "nan", "exit")
+_INT_KEYS = ("step", "rank", "code")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    step: int
+    rank: Optional[int] = None  # None = every process
+    secs: float = 3600.0  # hang duration
+    code: int = 1  # exit code for kind="exit"
+
+
+def parse_fault_plan(text: str) -> List[Fault]:
+    """Parse a ``FAULT_PLAN`` string (module docstring grammar)."""
+    faults: List[Fault] = []
+    for raw in (text or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, rest = raw.partition(":")
+        kind = kind.strip()
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {raw!r} "
+                f"(have {', '.join(FAULT_KINDS)})"
+            )
+        kw: dict = {}
+        for pair in rest.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise ValueError(
+                    f"fault directive {raw!r}: expected key=value, got {pair!r}"
+                )
+            k, v = (s.strip() for s in pair.split("=", 1))
+            if k not in ("step", "rank", "secs", "code"):
+                raise ValueError(f"fault directive {raw!r}: unknown key {k!r}")
+            kw[k] = int(v) if k in _INT_KEYS else float(v)
+        if "step" not in kw:
+            raise ValueError(f"fault directive {raw!r}: step= is required")
+        if kw["step"] < 1:
+            raise ValueError(
+                f"fault directive {raw!r}: step counts COMPLETED optimizer "
+                f"steps and must be >= 1"
+            )
+        faults.append(Fault(kind=kind, **kw))
+    return faults
+
+
+class FaultInjector:
+    """Step-indexed fault execution for this process.
+
+    The training loop (and the jax-light e2e children) call
+    :meth:`poison` before dispatching a step and :meth:`fire_after`
+    once a step (and its checkpoint, if due) completed. Each fault
+    fires at most once per process lifetime, so a restarted world that
+    resumes *past* the fault step recovers deterministically.
+    """
+
+    def __init__(self, faults: List[Fault], rank: int = 0):
+        self.rank = rank
+        self.pending = [
+            f for f in faults if f.rank is None or f.rank == rank
+        ]
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultInjector"]:
+        """Build from ``FAULT_PLAN`` (+ ``DDL_PROCESS_ID`` for the rank);
+        None when no plan is set — callers skip the per-step check."""
+        e = os.environ if env is None else env
+        plan = e.get("FAULT_PLAN")
+        if not plan:
+            return None
+        rank = int(e.get("DDL_PROCESS_ID", "0"))
+        inj = cls(parse_fault_plan(plan), rank=rank)
+        return inj if inj.pending else None
+
+    def _take(self, global_step: int, kinds) -> List[Fault]:
+        due = [
+            f for f in self.pending if f.step == global_step and f.kind in kinds
+        ]
+        if due:
+            self.pending = [f for f in self.pending if f not in due]
+        return due
+
+    def poison(self, global_step: int, batch):
+        """NaN-poison ``batch`` when a ``nan`` fault targets the step this
+        dispatch completes (``global_step``). Float leaves only — a
+        device-side elementwise multiply, no host sync."""
+        if not self._take(global_step, ("nan",)):
+            return batch
+        obs.point("fault_fired", kind="nan", step=global_step, rank=self.rank)
+        obs.flush()
+        import jax
+        import jax.numpy as jnp
+
+        def _p(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x * jnp.asarray(float("nan"), x.dtype)
+            return x
+
+        return jax.tree.map(_p, batch)
+
+    def due_after(self, global_step: int) -> bool:
+        """True when a process-terminating fault fires once ``global_step``
+        steps have completed (the loop drains checkpoints first, so the
+        resume point is deterministic)."""
+        return any(
+            f.step == global_step and f.kind != "nan" for f in self.pending
+        )
+
+    def fire_after(self, global_step: int) -> None:
+        """Execute the terminal fault(s) for ``global_step``. kill/term/
+        exit do not return; hang sleeps silently (the watchdog's prey)."""
+        for f in self._take(global_step, ("kill", "term", "hang", "exit")):
+            bus = obs.get_bus()
+            bus.point(
+                "fault_fired", kind=f.kind, step=f.step, rank=self.rank
+            )
+            bus.flush()
+            if f.kind == "kill":
+                # SIGKILL is unhandleable: dump the black box ourselves
+                # (the flight recorder's crash handlers never run).
+                if bus.directory:
+                    bus.dump_flight("fault_kill")
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "term":
+                # Preemption rehearsal: the installed SIGTERM handler
+                # dumps the flight ring and re-delivers the signal.
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(30)  # handler re-raises; never reached
+            elif f.kind == "hang":
+                # Silent but alive — the hang watchdog's exact signature.
+                time.sleep(f.secs)
+            elif f.kind == "exit":
+                sys.exit(f.code)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption (the partial-write fault)
+# ---------------------------------------------------------------------------
+
+def checkpoint_steps(directory: str) -> List[int]:
+    """Committed orbax step numbers under ``directory`` (numeric dirs;
+    tmp dirs from an interrupted async save are excluded)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(int(n) for n in names if n.isdigit())
+
+
+def corrupt_latest_checkpoint(
+    directory: str, truncate_to: int = 1
+) -> Optional[str]:
+    """Truncate every file of the NEWEST checkpoint step — the on-disk
+    state a preemption mid-write leaves behind. Returns the corrupted
+    step directory (None when there is no checkpoint). Drives
+    ``CheckpointManager``'s fall-back-to-previous-valid restore path."""
+    steps = checkpoint_steps(directory)
+    if not steps:
+        return None
+    target = os.path.join(directory, str(steps[-1]))
+    for root, _, files in os.walk(target):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                with open(path, "r+b") as fh:
+                    fh.truncate(min(truncate_to, os.path.getsize(path)))
+            except OSError:
+                pass
+    return target

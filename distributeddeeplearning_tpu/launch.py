@@ -42,6 +42,8 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import glob
+import json
 import os
 import re
 import shlex
@@ -54,6 +56,10 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 _DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+\s*")
+
+# Child liveness lines (utils/heartbeat.py): tick the hang watchdog but
+# never reach the streamed log.
+_HEARTBEAT_MAGIC = b"__ddl_heartbeat__"
 
 
 def find_free_port() -> int:
@@ -143,11 +149,18 @@ def _stream(
                 pending = lines.pop()
             else:
                 pending = b""
+            wrote = False
             for ln in lines:
+                # Heartbeat lines (emitted during long silent compiles,
+                # utils/heartbeat.py) already ticked the watchdog via
+                # the chunk read above; suppress them from the log.
+                if ln.startswith(_HEARTBEAT_MAGIC):
+                    continue
                 sink.write(prefix + ln.decode(errors="replace"))
-            if lines:
+                wrote = True
+            if wrote:
                 sink.flush()
-        if pending:
+        if pending and not pending.startswith(_HEARTBEAT_MAGIC):
             sink.write(prefix + pending.decode(errors="replace") + "\n")
             sink.flush()
 
@@ -168,6 +181,7 @@ def launch_local(
     timeout: Optional[float] = None,
     hang_timeout: Optional[float] = None,
     obs_dir: Optional[str] = None,
+    launcher_proc: str = "launcher",
     sink=None,
 ) -> int:
     """Run ``script`` in ``num_processes`` local python processes.
@@ -196,6 +210,13 @@ def launch_local(
     coordinator = f"127.0.0.1:{find_free_port()}"
     lbus = None
     extra_env = dict(env or {})
+    if hang_timeout:
+        # Arm the children's compile-phase heartbeat (utils/heartbeat.py)
+        # so a long silent AOT compile is not mistaken for a hang; the
+        # magic lines tick the watchdog and are filtered from the log.
+        extra_env.setdefault(
+            "DDL_HEARTBEAT_EVERY_S", f"{max(hang_timeout / 3.0, 0.5):g}"
+        )
     if obs_dir:
         from distributeddeeplearning_tpu.obs import EventBus
 
@@ -207,7 +228,10 @@ def launch_local(
         )
         # A PRIVATE bus (not the process-global one): launching is an
         # action inside some caller's process, not that process's run.
-        lbus = EventBus(directory=obs_dir, run_id=run_id, proc="launcher")
+        # The supervisor names each attempt's launcher distinctly
+        # ("launcher", "launcher-r1", ...) so restarts never truncate an
+        # earlier attempt's lifecycle record.
+        lbus = EventBus(directory=obs_dir, run_id=run_id, proc=launcher_proc)
         extra_env["OBS_DIR"] = obs_dir
         extra_env["OBS_RUN_ID"] = run_id
     procs: List[subprocess.Popen] = []
@@ -317,6 +341,169 @@ def launch_local(
 
 class _ChildFailed(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Restart supervisor (fault tolerance — docs/ROBUSTNESS.md)
+# ---------------------------------------------------------------------------
+
+def _flight_reasons(obs_dir: str, attempt: int) -> List[str]:
+    """Black-box verdicts for one attempt: the ``reason`` field of every
+    flight dump that attempt's processes left behind (``flight-p0.jsonl``
+    for attempt 0, ``flight-p0-r<k>.jsonl`` for restart k)."""
+    tag = f"-r{attempt}" if attempt else ""
+    out: List[str] = []
+    for path in sorted(glob.glob(os.path.join(obs_dir, "flight-*.jsonl"))):
+        stem = os.path.basename(path)[len("flight-"):-len(".jsonl")]
+        if attempt:
+            if not stem.endswith(tag):
+                continue
+            stem = stem[: -len(tag)]
+        elif "-r" in stem:
+            continue
+        try:
+            with open(path) as fh:
+                head = json.loads(fh.readline())
+        except (OSError, json.JSONDecodeError):
+            continue
+        out.append(f"{stem}:{head.get('reason', '?')}")
+    return out
+
+
+def launch_supervised(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    max_restarts: int = 0,
+    restart_backoff: float = 1.0,
+    backoff_cap: float = 60.0,
+    env: Optional[Dict[str, str]] = None,
+    obs_dir: Optional[str] = None,
+    sink=None,
+    **launch_kw,
+) -> int:
+    """Run ``launch_local`` under a restart supervisor.
+
+    On a retryable world death (child crash/signal, watchdog kill,
+    simulated preemption) the world is torn down, the failure classified
+    from the exit code (``faults.classify_exit``) plus any flight-recorder
+    dumps, and the whole world relaunched with exponential backoff —
+    ``restart_backoff * 2**attempt`` seconds, capped — up to
+    ``max_restarts`` times. Every restart attempt:
+
+    * exports ``RESUME=True`` so the children auto-resume from the
+      newest valid checkpoint (step-granular when
+      ``CHECKPOINT_EVERY_STEPS`` is set — see ``training/checkpoint.py``);
+    * exports ``OBS_PROC_SUFFIX=-r<k>`` + a distinct launcher identity so
+      each attempt's event/flight files survive into one merged failure
+      timeline (rendered by ``scripts/obs_report.py``);
+    * exports ``DDL_RESTART=<k>`` for anything that wants to know.
+
+    Non-retryable exits (success, the non-finite-loss guard's 121,
+    timeout 124, operator interrupt 130) return immediately. The return
+    value is shell-normalized (signal deaths become 128+N). ``--timeout``
+    and ``--hang-timeout`` apply per attempt.
+    """
+    from distributeddeeplearning_tpu import faults
+
+    sink = sink or sys.stdout
+    base_env = dict(env or {})
+    sbus = None
+    if obs_dir:
+        from distributeddeeplearning_tpu.obs import EventBus
+
+        obs_dir = os.path.abspath(obs_dir)
+        run_id = (
+            base_env.get("OBS_RUN_ID")
+            or os.environ.get("OBS_RUN_ID")
+            or f"run-{int(time.time())}"
+        )
+        # One run id for every attempt: the supervisor owns the run.
+        base_env["OBS_RUN_ID"] = run_id
+        sbus = EventBus(directory=obs_dir, run_id=run_id, proc="supervisor")
+    attempt = 0
+    try:
+        while True:
+            extra = dict(base_env)
+            if attempt:
+                extra["OBS_PROC_SUFFIX"] = f"-r{attempt}"
+                extra["DDL_RESTART"] = str(attempt)
+                extra["RESUME"] = "True"  # resume from the newest checkpoint
+            if sbus is not None:
+                sbus.point("attempt_start", attempt=attempt)
+                sbus.flush()
+            rc = launch_local(
+                script,
+                script_args,
+                env=extra,
+                obs_dir=obs_dir,
+                launcher_proc=(
+                    "launcher" if attempt == 0 else f"launcher-r{attempt}"
+                ),
+                sink=sink,
+                **launch_kw,
+            )
+            verdict = faults.classify_exit(rc)
+            flight = _flight_reasons(obs_dir, attempt) if obs_dir else []
+            if sbus is not None:
+                sbus.point(
+                    "attempt_exit",
+                    attempt=attempt,
+                    rc=rc,
+                    retryable=verdict.retryable,
+                    reason=verdict.reason,
+                    flight=", ".join(flight) or None,
+                )
+                sbus.flush()
+            if rc == 0:
+                return 0
+            if not verdict.retryable:
+                sink.write(
+                    f"supervisor: rc={rc} ({verdict.reason}) is "
+                    "non-retryable; giving up\n"
+                )
+                return faults.normalize_rc(rc)
+            if attempt >= max_restarts:
+                sink.write(
+                    f"supervisor: restart budget exhausted "
+                    f"({max_restarts}); last failure rc={rc} "
+                    f"({verdict.reason})\n"
+                )
+                return faults.normalize_rc(rc)
+            delay = min(restart_backoff * (2 ** attempt), backoff_cap)
+            sink.write(
+                f"supervisor: attempt {attempt} failed (rc={rc}, "
+                f"{verdict.reason}"
+                + (f"; flight: {', '.join(flight)}" if flight else "")
+                + f"); restarting in {delay:g}s with resume enabled "
+                f"(restart {attempt + 1}/{max_restarts})\n"
+            )
+            if sbus is not None:
+                sbus.counter("restarts")
+                sbus.point(
+                    "restart_scheduled",
+                    attempt=attempt + 1,
+                    backoff_s=delay,
+                    rc=rc,
+                    reason=verdict.reason,
+                )
+                sbus.flush()
+            time.sleep(delay)
+            attempt += 1
+    finally:
+        if sbus is not None:
+            sbus.point("supervisor_exit")
+            sbus.close()
+            try:
+                # Fold the supervisor's own record into the merged
+                # timeline (launch_local merged before our final events).
+                from distributeddeeplearning_tpu.obs.report import (
+                    merge_run_dir,
+                )
+
+                merge_run_dir(obs_dir)
+            except Exception as e:  # merging must never mask the rc
+                sink.write(f"supervisor: event merge failed: {e!r}\n")
 
 
 # ---------------------------------------------------------------------------
@@ -508,6 +695,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "launcher lifecycle events, merged report input "
         "(default: $OBS_DIR; see docs/OBSERVABILITY.md)",
     )
+    ap.add_argument(
+        "--max-restarts",
+        type=int,
+        default=int(os.environ.get("MAX_RESTARTS", "0")),
+        help="restart supervisor: relaunch the world up to N times after "
+        "a retryable failure (crash/signal/watchdog), resuming from the "
+        "newest checkpoint (default: $MAX_RESTARTS or 0 = off; "
+        "docs/ROBUSTNESS.md)",
+    )
+    ap.add_argument(
+        "--restart-backoff",
+        type=float,
+        default=float(os.environ.get("RESTART_BACKOFF", "1.0")),
+        help="base seconds between restarts (exponential: base * 2^attempt,"
+        " capped at 60s; default: $RESTART_BACKOFF or 1.0)",
+    )
     ap.add_argument("--no-tag-output", action="store_true")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -526,6 +729,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ):
             if val is not None:
                 ap.error(f"{flag} applies to local mode only, not --tpu")
+        if args.max_restarts:
+            ap.error(
+                "--max-restarts applies to local mode only, not --tpu "
+                "(pod jobs are resubmitted through orchestration/submit)"
+            )
         if args.obs_dir:
             # Pod mode: no shared filesystem to merge on — each worker
             # writes its own event files under OBS_DIR on its VM (fetch
@@ -547,17 +755,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             f"{args.script} {' '.join(args.script_args)}"
         )
         return 0
-    return launch_local(
-        args.script,
-        args.script_args,
+    local_kw = dict(
         num_processes=n,
         devices_per_process=args.devices_per_process,
         platform=args.platform,
-        env=extra_env,
         tag_output=not args.no_tag_output,
         timeout=args.timeout,
         hang_timeout=args.hang_timeout,
+    )
+    if args.max_restarts > 0:
+        return launch_supervised(
+            args.script,
+            args.script_args,
+            max_restarts=args.max_restarts,
+            restart_backoff=args.restart_backoff,
+            env=extra_env,
+            obs_dir=args.obs_dir,
+            **local_kw,
+        )
+    return launch_local(
+        args.script,
+        args.script_args,
+        env=extra_env,
         obs_dir=args.obs_dir,
+        **local_kw,
     )
 
 

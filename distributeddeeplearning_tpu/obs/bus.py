@@ -81,6 +81,14 @@ class EventBus:
         self._lock = threading.Lock()
         if proc is None:
             proc = int(os.environ.get("DDL_PROCESS_ID", "0"))
+            # Restart supervisor (launch.launch_supervised): attempt k>0
+            # exports OBS_PROC_SUFFIX="-rk" so a relaunched process does
+            # NOT truncate attempt k-1's event/flight files — every
+            # attempt keeps its own identity in the merged failure
+            # timeline (events-p0.jsonl, events-p0-r1.jsonl, ...).
+            suffix = os.environ.get("OBS_PROC_SUFFIX", "")
+            if suffix:
+                proc = f"p{proc}{suffix}"
         self.proc = proc
         self.run_id = run_id or f"run-{int(time.time())}-{os.getpid()}"
         self.directory = os.path.abspath(directory) if directory else None

@@ -361,6 +361,38 @@ def _is_ssh(cmd: Sequence[str]) -> bool:
     return any(c in ("ssh", "scp") for c in cmd)
 
 
+def call_with_retries(
+    cmd: Sequence[str],
+    *,
+    attempts: int = 1,
+    delay_s: float = 5.0,
+    sink=None,
+    what: str = "ssh",
+    runner=None,
+) -> int:
+    """Run ``cmd`` up to ``attempts`` times with exponential backoff
+    (``delay_s * 2**attempt`` between tries) — the one retry policy for
+    transient gcloud/ssh failures, shared by the provisioner's setup
+    steps and the submitter's stream/status/stop calls. ``runner``
+    overrides the executor (the submitter wraps it in an obs span)."""
+    runner = runner or (lambda c: subprocess.call(list(c)))
+    sink = sink or sys.stdout
+    attempts = max(attempts, 1)
+    rc = 0
+    for attempt in range(attempts):
+        rc = runner(cmd)
+        if rc == 0:
+            return 0
+        if attempt + 1 < attempts:
+            delay = delay_s * (2**attempt)
+            sink.write(
+                f"{what} attempt {attempt + 1}/{attempts} failed "
+                f"(rc={rc}); retrying in {delay:g}s\n"
+            )
+            time.sleep(delay)
+    return rc
+
+
 def run_commands(
     cmds: Sequence[Sequence[str]],
     dry_run: bool,
@@ -379,19 +411,12 @@ def run_commands(
         sink.write(_fmt(cmd) + "\n")
         if dry_run:
             continue
-        attempts = max(ssh_retries, 1) if _is_ssh(cmd) else 1
-        rc = 0
-        for attempt in range(attempts):
-            rc = subprocess.call(list(cmd))
-            if rc == 0:
-                break
-            if attempt + 1 < attempts:
-                delay = retry_delay_s * (2**attempt)
-                sink.write(
-                    f"ssh attempt {attempt + 1}/{attempts} failed "
-                    f"(rc={rc}); retrying in {delay:g}s\n"
-                )
-                time.sleep(delay)
+        rc = call_with_retries(
+            cmd,
+            attempts=max(ssh_retries, 1) if _is_ssh(cmd) else 1,
+            delay_s=retry_delay_s,
+            sink=sink,
+        )
         if rc != 0:
             sink.write(f"ERROR: step failed (rc={rc}): {_fmt(cmd)}\n")
             return rc

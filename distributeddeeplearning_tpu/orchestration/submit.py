@@ -158,20 +158,50 @@ def control_command(
     return ssh_command(tpu, zone, remote, project=project)
 
 
-def _call_surfaced(cmd: Sequence[str]) -> int:
+def _call_surfaced(
+    cmd: Sequence[str], *, retries: int = 1, retry_delay_s: float = 5.0
+) -> int:
     """subprocess.call with the failure made loud: a nonzero rc (pod
     unreachable, job crashed in foreground mode, worker ssh refused)
     prints an ERROR line naming the command instead of silently becoming
-    the exit code."""
-    with obs.span("gcloud", what=cmd[0] if cmd else "?"):
-        rc = subprocess.call(list(cmd))
+    the exit code.
+
+    ``retries > 1`` applies the provisioner's exponential-backoff policy
+    (``provision.call_with_retries``) — the stream/status/stop calls and
+    detached submits go through a TPU-VM ssh that fails transiently
+    exactly like the setup steps do; each attempt still gets its obs
+    span, plus a ``gcloud_retry`` counter when a retry fires.
+    """
+    from distributeddeeplearning_tpu.orchestration.provision import (
+        call_with_retries,
+    )
+
+    state = {"attempt": 0}
+
+    def _run(c: Sequence[str]) -> int:
+        state["attempt"] += 1
+        if state["attempt"] > 1:
+            obs.counter("gcloud_retry", attempt=state["attempt"])
+        with obs.span("gcloud", what=c[0] if c else "?"):
+            rc = subprocess.call(list(c))
+        if rc != 0:
+            obs.point("gcloud_failed", rc=rc)
+        return rc
+
+    rc = call_with_retries(
+        cmd,
+        attempts=retries,
+        delay_s=retry_delay_s,
+        sink=sys.stderr,
+        what="gcloud",
+        runner=_run,
+    )
     if rc != 0:
         sys.stderr.write(
             f"ERROR: command failed (rc={rc}): "
             + " ".join(shlex.quote(c) for c in cmd)
             + "\n"
         )
-        obs.point("gcloud_failed", rc=rc)
     return rc
 
 
@@ -196,6 +226,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--tpu", default=None)
     ap.add_argument("--zone", default=None)
     ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument(
+        "--retries",
+        type=int,
+        default=3,
+        help="attempts for transiently-failing gcloud/ssh actions "
+        "(stream/status/stop + detached submits; exponential backoff — "
+        "the provisioner's ssh policy). Foreground runs never retry: a "
+        "crashed training job is not a transient ssh error.",
+    )
+    ap.add_argument(
+        "--retry-delay", type=float, default=5.0,
+        help="base backoff seconds between retries",
+    )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     run = sub.add_parser("run", help="submit a training run")
@@ -283,7 +326,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.dry_run:
             return 0
         for i, cmd in enumerate(cmds):
-            rc = _call_surfaced(cmd)
+            # Detached submits are one transient-prone ssh round trip —
+            # retryable; a foreground run streams the training itself
+            # and must surface its rc untouched.
+            rc = _call_surfaced(
+                cmd,
+                retries=args.retries if args.detach else 1,
+                retry_delay_s=args.retry_delay,
+            )
             if rc:
                 if i > 0:
                     # Slices 0..i-1 already hold a detached job waiting at
@@ -328,9 +378,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # status/stop must reach EVERY node even if one fails — returning on
     # the first error would leave a half-stopped multi-slice job wedged
     # at its next collective (first nonzero rc reported at the end).
+    # All three actions ride a transient-prone ssh: retried with the
+    # provisioner's backoff policy before counting as failed.
     first_rc = 0
     for cmd in cmds:
-        rc = _call_surfaced(cmd)
+        rc = _call_surfaced(
+            cmd, retries=args.retries, retry_delay_s=args.retry_delay
+        )
         first_rc = first_rc or rc
     return first_rc
 

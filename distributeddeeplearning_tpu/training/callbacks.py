@@ -125,17 +125,32 @@ class ModelCheckpointCallback(Callback):
                 CheckpointManager,
             )
 
-            directory = self.directory or (self.context.get("config").model_dir
-                                           if self.context.get("config") else None)
+            cfg = self.context.get("config")
+            directory = self.directory or (cfg.model_dir if cfg else None)
+            # Honour the config's robustness contract so a callback-owned
+            # manager keys checkpoints exactly like an engine-owned one
+            # (CHECKPOINT_EVERY_STEPS / CHECKPOINT_ASYNC — the loop's
+            # mid-epoch saves and resume go through this same manager).
             self._mgr = CheckpointManager(
-                directory, save_every_epochs=self.save_every_epochs
+                directory,
+                save_every_epochs=self.save_every_epochs,
+                save_every_steps=getattr(cfg, "checkpoint_every_steps", 0)
+                if cfg else 0,
+                async_save=getattr(cfg, "checkpoint_async", True)
+                if cfg else True,
             )
         return self._mgr
 
     def on_epoch_end(self, epoch, logs=None):
-        state = (logs or {}).get("state")
+        logs = logs or {}
+        state = logs.get("state")
         if state is not None:
-            self.manager().save(epoch, state)
+            # save_epoch_end keeps the key space consistent when the
+            # shared manager is step-granular (CHECKPOINT_EVERY_STEPS);
+            # plain epoch keying otherwise.
+            self.manager().save_epoch_end(
+                epoch, state, global_step=logs.get("global_step")
+            )
 
     def on_train_end(self, logs=None):
         if self._mgr is not None:

@@ -15,12 +15,35 @@ participates in writing its addressable shards; metadata is committed by
 process 0), so there is no temp-dir hack — and restore places shards
 directly onto the mesh via the state's sharding, replacing the Keras
 "load on rank 0 then broadcast" dance.
+
+Robustness layer (ISSUE 4):
+
+* **Step-granular checkpointing** — ``save_every_steps > 0`` (env
+  ``CHECKPOINT_EVERY_STEPS``) switches the manager onto *global-step*
+  keying: every orbax step number is the count of completed optimizer
+  steps (epoch-boundary saves land on ``(epoch+1) * steps_per_epoch``,
+  mid-epoch saves in between), so a preemption loses minutes of work,
+  not an epoch — the Check-N-Run-style frequent-checkpoint posture.
+  ``maybe_restore_at`` decodes the key back into ``(epoch,
+  step_in_epoch)`` and the loop skips exactly that many batches of the
+  resume epoch, keeping the resumed run bitwise-equal to an
+  uninterrupted one under the determinism contract
+  (``tests/test_fault_tolerance.py``).
+* **Corrupt-checkpoint fallback** — ``maybe_restore``/``maybe_restore_at``
+  walk checkpoints newest-first and fall back past any that fail to
+  load (the partial write a preemption mid-save leaves behind; rehearsed
+  by ``faults.corrupt_latest_checkpoint``), emitting a
+  ``checkpoint_corrupt`` obs point per skipped step.
+* ``async_save=False`` (env ``CHECKPOINT_ASYNC=0``) makes every save
+  durable before ``save*`` returns — what the deterministic
+  fault-injection oracles use so "killed after step N" implies
+  "checkpoint N is committed".
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -36,7 +59,8 @@ class CheckpointManager:
 
     ``save_every_epochs`` mirrors the Keras per-epoch ``ModelCheckpoint``;
     ``max_to_keep`` defaults to 3 (the reference kept every .h5 — an
-    unbounded-disk footgun we don't reproduce).
+    unbounded-disk footgun we don't reproduce). ``save_every_steps > 0``
+    switches to global-step keying (module docstring).
     """
 
     def __init__(
@@ -45,9 +69,15 @@ class CheckpointManager:
         *,
         max_to_keep: int = 3,
         save_every_epochs: int = 1,
+        save_every_steps: int = 0,
+        async_save: bool = True,
     ):
         self._log = get_logger()
         self._save_every = max(save_every_epochs, 1)
+        self._every_steps = max(int(save_every_steps), 0)
+        # Set by the loop at resume time; needed to decode step-granular
+        # keys back into (epoch, step_in_epoch).
+        self._steps_per_epoch: Optional[int] = None
         if directory is None:
             self._mgr = None
             return
@@ -57,7 +87,7 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 create=True,
-                enable_async_checkpointing=True,
+                enable_async_checkpointing=bool(async_save),
             ),
         )
 
@@ -65,8 +95,19 @@ class CheckpointManager:
     def enabled(self) -> bool:
         return self._mgr is not None
 
+    @property
+    def step_granular(self) -> bool:
+        """True when this manager keys checkpoints by global optimizer
+        step (``CHECKPOINT_EVERY_STEPS > 0``) rather than by epoch."""
+        return self._every_steps > 0
+
     def save(self, epoch: int, state: PyTree, force: bool = False) -> bool:
-        """Save at end of ``epoch`` (0-based) if due; returns True if saved."""
+        """Save at end of ``epoch`` (0-based) if due; returns True if saved.
+
+        Epoch-keyed — callers on the step-granular contract use
+        :meth:`save_epoch_end` (which maps the epoch boundary onto its
+        global-step key) instead.
+        """
         if self._mgr is None:
             return False
         if not force and (epoch + 1) % self._save_every != 0:
@@ -76,6 +117,42 @@ class CheckpointManager:
         if saved:
             self._log.info("checkpoint saved", extra={"epoch": epoch})
         return bool(saved)
+
+    def save_step(
+        self, global_step: int, state: PyTree, force: bool = False
+    ) -> bool:
+        """Step-granular save: key = completed optimizer steps. Due every
+        ``save_every_steps``; ``force`` saves regardless (the epoch
+        boundary). Idempotent per key — a boundary that coincides with a
+        due step is saved once."""
+        if self._mgr is None or not self.step_granular:
+            return False
+        if not force and (
+            global_step <= 0 or global_step % self._every_steps != 0
+        ):
+            return False
+        if self._mgr.latest_step() == global_step:
+            return False  # already saved (epoch boundary == due step)
+        with obs.span("checkpoint_save", step=global_step):
+            saved = self._mgr.save(
+                global_step, args=ocp.args.StandardSave(state)
+            )
+        if saved:
+            self._log.info("checkpoint saved", extra={"step": global_step})
+        return bool(saved)
+
+    def save_epoch_end(
+        self, epoch: int, state: PyTree, global_step: Optional[int] = None
+    ) -> bool:
+        """The loop's (and checkpoint callback's) one epoch-boundary call,
+        valid under either keying: epoch mode defers to :meth:`save`;
+        step mode saves under the boundary's global-step key when the
+        epoch policy says the epoch is due."""
+        if self.step_granular and global_step is not None:
+            if (epoch + 1) % self._save_every != 0:
+                return False
+            return self.save_step(global_step, state, force=True)
+        return self.save(epoch, state)
 
     def latest_epoch(self) -> Optional[int]:
         """The resume epoch — every process reads the same answer from the
@@ -102,13 +179,66 @@ class CheckpointManager:
         self._log.info("checkpoint restored", extra={"epoch": step})
         return restored
 
+    def _restore_latest_valid(
+        self, state: PyTree
+    ) -> Tuple[PyTree, Optional[int]]:
+        """Newest-first restore with corruption fallback: a checkpoint
+        that fails to load (truncated by a preemption mid-write) is
+        skipped with a warning + ``checkpoint_corrupt`` obs point and the
+        next-older one is tried. ``(state unchanged, None)`` when nothing
+        restores."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        for step in steps:
+            try:
+                return self.restore(state, step), step
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._log.warning(
+                    "checkpoint %d unreadable (%r); falling back to the "
+                    "previous one",
+                    step,
+                    e,
+                )
+                obs.point("checkpoint_corrupt", step=step, error=repr(e))
+        return state, None
+
     def maybe_restore(self, state: PyTree) -> tuple[PyTree, int]:
         """Reference resume contract: returns ``(state, start_epoch)`` —
-        ``(unchanged state, 0)`` when nothing to resume."""
-        latest = self.latest_epoch() if self.enabled else None
-        if latest is None:
-            return state, 0
-        return self.restore(state, latest), latest + 1
+        ``(unchanged state, 0)`` when nothing to resume (or every
+        checkpoint is corrupt)."""
+        restored, epoch, skip = self.maybe_restore_at(state)
+        if skip:
+            raise ValueError(
+                "mid-epoch checkpoint found but caller uses the epoch-only "
+                "resume contract — resume through maybe_restore_at()"
+            )
+        return restored, epoch
+
+    def maybe_restore_at(
+        self, state: PyTree, steps_per_epoch: Optional[int] = None
+    ) -> Tuple[PyTree, int, int]:
+        """Step-granular resume contract: ``(state, start_epoch,
+        skip_steps)`` — resume training at ``start_epoch``, skipping its
+        first ``skip_steps`` batches. Epoch-keyed directories always
+        return ``skip_steps == 0``. Falls back past corrupt checkpoints
+        (``_restore_latest_valid``)."""
+        if steps_per_epoch:
+            self._steps_per_epoch = int(steps_per_epoch)
+        if not self.enabled:
+            return state, 0, 0
+        restored, key = self._restore_latest_valid(state)
+        if key is None:
+            return state, 0, 0
+        if not self.step_granular:
+            return restored, key + 1, 0
+        spe = self._steps_per_epoch
+        if not spe:
+            raise ValueError(
+                "step-granular restore needs steps_per_epoch to decode the "
+                "checkpoint key (pass it to maybe_restore_at)"
+            )
+        return restored, key // spe, key % spe
 
     def wait(self) -> None:
         """Block until async saves are durable (call at end of training)."""

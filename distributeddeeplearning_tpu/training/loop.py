@@ -10,7 +10,9 @@ callbacks, checkpointing, and the canonical throughput summary.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import itertools
 import time
 from typing import Any, Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
 
@@ -18,7 +20,7 @@ import jax
 import numpy as np
 import optax
 
-from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu import faults, obs
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.data.pipeline import prefetch_to_device
 from distributeddeeplearning_tpu.parallel import collectives
@@ -34,7 +36,7 @@ from distributeddeeplearning_tpu.training.metrics import (
 )
 from distributeddeeplearning_tpu.training.optimizer import create_optimizer
 from distributeddeeplearning_tpu.training.state import TrainState
-from distributeddeeplearning_tpu.utils import hostsync
+from distributeddeeplearning_tpu.utils import heartbeat, hostsync
 from distributeddeeplearning_tpu.utils.logging import get_logger, log_summary
 from distributeddeeplearning_tpu.utils.timer import Timer
 
@@ -99,6 +101,16 @@ def resolve_engine(config, mesh=None):
     from distributeddeeplearning_tpu.training.accum import resolve_accum_steps
 
     resolve_accum_steps(config)
+    if config.nonfinite_action not in ("abort", "warn", "off"):
+        raise ValueError(
+            f"NONFINITE_ACTION={config.nonfinite_action!r} "
+            "(have abort, warn, off)"
+        )
+    if config.checkpoint_every_steps < 0:
+        raise ValueError(
+            f"CHECKPOINT_EVERY_STEPS must be >= 0, got "
+            f"{config.checkpoint_every_steps}"
+        )
     if mesh is None:
         # Engine-appropriate default topology when the user named an
         # engine but no mesh at all: ENGINE=pp → (data, pipe) with
@@ -236,19 +248,38 @@ def fit(
         ckpt = ckpt_cb.manager()
     if ckpt is None and config.model_dir:
         ckpt = CheckpointManager(
-            config.model_dir, save_every_epochs=config.checkpoint_every_epochs
+            config.model_dir,
+            save_every_epochs=config.checkpoint_every_epochs,
+            save_every_steps=config.checkpoint_every_steps,
+            async_save=config.checkpoint_async,
         )
     engine_saves = ckpt is not None and ckpt_cb is None
 
     # Keras resume contract (reference :323-341): load_weights +
     # initial_epoch skips completed epochs and keeps the LR schedule
-    # position. Checkpoint-derived epoch wins if it is further along.
+    # position. Checkpoint-derived position wins if it is further along.
+    # Step-granular checkpoints (CHECKPOINT_EVERY_STEPS) resume
+    # MID-epoch: the first skip_steps batches of the resume epoch were
+    # already trained and are skipped below, so a preemption loses
+    # minutes, not an epoch (docs/ROBUSTNESS.md).
     start_epoch = initial_epoch
+    skip_steps = 0
     if ckpt is not None and ckpt.enabled and config.resume:
-        state, ckpt_epoch = ckpt.maybe_restore(state)
-        start_epoch = max(start_epoch, ckpt_epoch)
-        if start_epoch:
-            log.info("resuming from epoch %d", start_epoch)
+        state, ckpt_epoch, ckpt_skip = ckpt.maybe_restore_at(
+            state, steps_per_epoch
+        )
+        if (ckpt_epoch, ckpt_skip) > (start_epoch, 0):
+            start_epoch, skip_steps = ckpt_epoch, ckpt_skip
+        if start_epoch or skip_steps:
+            log.info(
+                "resuming from epoch %d step %d", start_epoch, skip_steps
+            )
+            bus.point("resume", epoch=start_epoch, step_in_epoch=skip_steps)
+    # Host-side count of completed optimizer steps — the checkpoint key
+    # and the fault-plan clock. Assumes the dataset honours its declared
+    # steps_per_epoch (every repo dataset does).
+    global_step = start_epoch * steps_per_epoch + skip_steps
+    injector = faults.FaultInjector.from_env()
 
     train_step = eng.train_step
     eval_step = eng.eval_step if eval_data is not None else None
@@ -276,11 +307,13 @@ def fit(
         model=config.model,
         epochs=epochs,
         start_epoch=start_epoch,
+        start_step_in_epoch=skip_steps,
         steps_per_epoch=steps_per_epoch,
         devices=jax.device_count(),
         accum_steps=getattr(train_step, "accum_steps", config.accum_steps),
     )
     metrics = {}
+    first_dispatch = True
     for epoch in range(start_epoch, epochs):
         if tracer is not None:
             tracer.maybe_start(epoch)
@@ -291,8 +324,16 @@ def fit(
         # ride the compiled step (donated), so epoch statistics build up
         # in HBM and the loop stays sync-free between epoch boundaries.
         acc = init_accumulator(mesh) if accumulates else None
+        batches = train_data.epoch(epoch)
+        if epoch == start_epoch and skip_steps:
+            # Mid-epoch resume: the dataset's epoch stream is
+            # deterministic in (seed, epoch), so dropping the first k
+            # batches — before any staging — replays exactly the part of
+            # the epoch the checkpoint had not yet covered.
+            batches = itertools.islice(batches, skip_steps, None)
+            bus.point("resume_skip", epoch=epoch, skipped=skip_steps)
         for batch in prefetch_to_device(
-            train_data.epoch(epoch), mesh, size=config.prefetch_batches,
+            batches, mesh, size=config.prefetch_batches,
             sharding=eng.batch_sharding,
         ):
             global_batch = int(jax.tree.leaves(batch)[0].shape[0])
@@ -302,11 +343,25 @@ def fit(
                 # compile_sec, not smeared into step time.
                 warmup_info = eng.warmup(batch, acc=acc)
                 warmup_pending = False
+            if injector is not None:
+                # Deterministic NaN injection (FAULT_PLAN nan:step=N):
+                # poisons the batch whose dispatch completes step N —
+                # an on-device multiply, no host sync.
+                batch = injector.poison(global_step + 1, batch)
             t0 = time.perf_counter()
-            if accumulates:
-                state, metrics, acc = train_step(state, batch, acc)
-            else:
-                state, metrics = train_step(state, batch)
+            # The run's first dispatch compiles when AOT warmup is off;
+            # heartbeat through it so the launcher's hang watchdog does
+            # not mistake a long silent compile for a dead world.
+            with (
+                heartbeat.during("first_step_compile")
+                if first_dispatch
+                else contextlib.nullcontext()
+            ):
+                if accumulates:
+                    state, metrics, acc = train_step(state, batch, acc)
+                else:
+                    state, metrics = train_step(state, batch)
+            first_dispatch = False
             dispatch_s = time.perf_counter() - t0
             clock.note_dispatch(dispatch_s)
             # Step span = dispatch time (host-side float, already in
@@ -314,6 +369,22 @@ def fit(
             # and, critically, no materialisation of device values.
             bus.span_event("step", dispatch_s, epoch=epoch)
             step_in_epoch += 1
+            global_step += 1
+            if ckpt is not None and ckpt.step_granular:
+                # Step-granular checkpoint (CHECKPOINT_EVERY_STEPS): a
+                # due save materialises the state — the documented
+                # durability-vs-sync trade; off (the default) the loop
+                # keeps its ≤1-sync/epoch contract. Runs for callback-
+                # owned managers too (the callback only covers the epoch
+                # boundary; save_step is idempotent per key).
+                ckpt.save_step(global_step, state)
+            if injector is not None and injector.due_after(global_step):
+                # Make pending saves durable first so the kill point is
+                # deterministic relative to the resume point, then die.
+                if ckpt is not None:
+                    ckpt.wait()
+                bus.flush()
+                injector.fire_after(global_step)
             if (
                 config.log_every_steps
                 and step_in_epoch % config.log_every_steps == 0
@@ -341,7 +412,43 @@ def fit(
                     epoch_values, label="epoch_metrics"
                 ).items()
             }
+        # Non-finite guard: the accumulator counted NaN/Inf-loss steps ON
+        # DEVICE; the count arrived inside the one materialisation above,
+        # so detection costs zero extra host syncs. Legacy steps without
+        # the accumulator are checked on the loss float just landed.
+        nonfinite_steps = int(epoch_logs.pop("nonfinite_steps", 0.0))
+        if not accumulates:
+            loss_v = epoch_logs.get("loss")
+            nonfinite_steps = int(
+                loss_v is not None and not np.isfinite(loss_v)
+            )
+        if nonfinite_steps and config.nonfinite_action != "off":
+            bus.point(
+                "nonfinite_loss",
+                epoch=epoch,
+                steps=nonfinite_steps,
+                action=config.nonfinite_action,
+            )
+            bus.flush()
+            if config.nonfinite_action == "abort":
+                log.error(
+                    "non-finite loss in %d step(s) of epoch %d — aborting "
+                    "with exit %d (non-retryable: a resume would replay "
+                    "the same batches into the same NaN)",
+                    nonfinite_steps, epoch, faults.EXIT_NONFINITE,
+                )
+                if bus.directory:
+                    bus.dump_flight("nonfinite_loss")
+                if ckpt is not None:
+                    ckpt.wait()
+                raise faults.NonFiniteLossError(epoch, nonfinite_steps)
+            log.warning(
+                "non-finite loss in %d step(s) of epoch %d "
+                "(NONFINITE_ACTION=warn: continuing)",
+                nonfinite_steps, epoch,
+            )
         epoch_logs["epoch_images"] = epoch_images
+        epoch_logs["global_step"] = global_step
 
         if eval_step is not None and eval_data is not None and config.validation:
             eval_metrics = _run_eval(
@@ -359,7 +466,9 @@ def fit(
         epoch_logs["state"] = state
         callback_list.on_epoch_end(epoch, epoch_logs)
         if engine_saves:
-            ckpt.save(epoch, state)
+            # One call for either keying: epoch-keyed saves as ever, or
+            # the boundary's global-step key under CHECKPOINT_EVERY_STEPS.
+            ckpt.save_epoch_end(epoch, state, global_step=global_step)
         bus.span_event(
             "epoch",
             time.monotonic() - epoch_t0,

@@ -46,10 +46,17 @@ METRIC_KEYS: Tuple[str, ...] = ("loss", "accuracy", "grad_norm")
 
 def init_accumulator(mesh=None, keys: Tuple[str, ...] = METRIC_KEYS) -> PyTree:
     """Fresh zeroed accumulator, replicated over ``mesh`` when given
-    (the shard_map engines take it with an unsharded ``P()`` in_spec)."""
+    (the shard_map engines take it with an unsharded ``P()`` in_spec).
+
+    Besides the metric sums + step count it carries ``nonfinite`` — the
+    on-device non-finite-loss counter (ISSUE 4's guard): one extra f32
+    add per step inside the already-compiled program, materialised with
+    the rest of the accumulator at the epoch boundary, so NaN/Inf
+    detection costs ZERO additional host syncs."""
     acc = {
         "sums": {k: jnp.zeros((), jnp.float32) for k in keys},
         "count": jnp.zeros((), jnp.float32),
+        "nonfinite": jnp.zeros((), jnp.float32),
     }
     if mesh is not None:
         from distributeddeeplearning_tpu.parallel.mesh import (
@@ -61,7 +68,8 @@ def init_accumulator(mesh=None, keys: Tuple[str, ...] = METRIC_KEYS) -> PyTree:
 
 
 def accumulate_metrics(acc: PyTree, metrics: Dict[str, jnp.ndarray]) -> PyTree:
-    """One fused-into-the-step update: sums += metrics, count += 1.
+    """One fused-into-the-step update: sums += metrics, count += 1 (and
+    nonfinite += [loss is NaN/Inf]).
 
     All math is f32 adds in step order, so the finalized mean is
     bit-identical to a host-side f32 running mean of the same per-step
@@ -70,13 +78,25 @@ def accumulate_metrics(acc: PyTree, metrics: Dict[str, jnp.ndarray]) -> PyTree:
         k: acc["sums"][k] + metrics[k].astype(jnp.float32)
         for k in acc["sums"]
     }
-    return {"sums": sums, "count": acc["count"] + jnp.float32(1.0)}
+    out = {"sums": sums, "count": acc["count"] + jnp.float32(1.0)}
+    if "nonfinite" in acc:  # pre-guard accumulator pytrees pass through
+        loss = metrics["loss"].astype(jnp.float32)
+        out["nonfinite"] = acc["nonfinite"] + jnp.where(
+            jnp.isfinite(loss), jnp.float32(0.0), jnp.float32(1.0)
+        )
+    return out
 
 
 def finalize_accumulator(acc: PyTree) -> Dict[str, jnp.ndarray]:
-    """Epoch means (device values — the caller owns the one host sync)."""
+    """Epoch means (device values — the caller owns the one host sync).
+    The non-finite step COUNT rides along as ``nonfinite_steps`` (a
+    count, not a mean: one poisoned step must trip the guard even in a
+    long epoch)."""
     safe = jnp.maximum(acc["count"], jnp.float32(1.0))
-    return {k: v / safe for k, v in acc["sums"].items()}
+    out = {k: v / safe for k, v in acc["sums"].items()}
+    if "nonfinite" in acc:
+        out["nonfinite_steps"] = acc["nonfinite"]
+    return out
 
 
 class StepFn:

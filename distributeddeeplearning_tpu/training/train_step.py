@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -597,5 +598,28 @@ def make_eval_step(
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place a host-side state replicated across the mesh."""
+    """Place a host-side state replicated across the mesh.
+
+    Multi-process: every process already computed the identical value
+    (deterministic seeded init ≙ the broadcast; checkpoint restore
+    places identical shards), so the state is materialised to host numpy
+    and assembled with ``host_local_array_to_global_array`` — each
+    process uploads its local copy, no cross-process traffic at all.
+    The naive ``device_put(state, non_addressable_sharding)`` instead
+    runs a per-leaf ``multihost_utils.assert_equal`` — a full-data
+    broadcast per leaf — whose gloo ops interleave and collide on the
+    CPU backend (``op.preamble.length <= op.nbytes`` aborts that killed
+    every 2-process world at engine build). One boundary-time host trip,
+    before training starts — the hot loop's sync accounting is untouched.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        host_state = jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "addressable_data") else x,
+            state,
+        )
+        return multihost_utils.host_local_array_to_global_array(
+            host_state, mesh, P()
+        )
     return jax.device_put(state, replicated_sharding(mesh))

@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 
 from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.utils import heartbeat
 from distributeddeeplearning_tpu.utils.logging import get_logger
 
 _stats = {"hits": 0, "misses": 0}
@@ -155,17 +156,23 @@ def warmup_engine(
     if accum_steps > 1:
         info["accum_steps"] = float(accum_steps)
     if hasattr(step, "aot_compile"):
+        # Heartbeat while XLA works: an AOT compile is silent for
+        # minutes at pod scale, and the launcher's hang watchdog counts
+        # stdout as liveness — without this a healthy, compiling world
+        # gets killed at --hang-timeout (utils/heartbeat.py).
         with obs.span(
             "compile", what="train_step", engine=eng.name,
             accum_steps=accum_steps,
-        ):
+        ), heartbeat.during("aot_compile:train_step"):
             compiled, secs = step.aot_compile(eng.state, batch, acc)
         info["train_compile_sec"] = secs
         flops = cost_analysis_flops(compiled)
         if flops is not None:
             info["train_flops_per_step"] = flops
     if eval_batch is not None and hasattr(eng.eval_step, "aot_compile"):
-        with obs.span("compile", what="eval_step", engine=eng.name):
+        with obs.span(
+            "compile", what="eval_step", engine=eng.name
+        ), heartbeat.during("aot_compile:eval_step"):
             _, secs = eng.eval_step.aot_compile(eng.state, eval_batch)
         info["eval_compile_sec"] = secs
 

@@ -1,0 +1,119 @@
+"""Deterministic fault-injection toolkit — the FAULT_PLAN CLI.
+
+Companion to ``distributeddeeplearning_tpu/faults.py`` (grammar,
+injector) and ``docs/ROBUSTNESS.md`` (failure model). Three actions:
+
+* ``validate "PLAN"`` — parse a ``FAULT_PLAN`` string and print the
+  per-process fault schedule it encodes (exit 2 on a grammar error,
+  with the offending directive named) — dry-run a plan before spending
+  a pod run on it.
+* ``corrupt-latest CKPT_DIR`` — truncate every file of the newest
+  committed checkpoint step: the exact on-disk state a preemption
+  mid-write leaves behind, driving ``CheckpointManager``'s
+  fall-back-to-previous-valid restore.
+* ``exit-codes`` — print the exit-code taxonomy the restart supervisor
+  enforces (which world exits are retried, which are terminal).
+
+Usage::
+
+    python scripts/faultgen.py validate "kill:step=3,rank=1;nan:step=2"
+    python scripts/faultgen.py corrupt-latest /path/to/model_dir
+    python scripts/faultgen.py exit-codes
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from distributeddeeplearning_tpu import faults  # noqa: E402
+
+
+def _cmd_validate(args) -> int:
+    try:
+        plan = faults.parse_fault_plan(args.plan)
+    except ValueError as e:
+        print(f"invalid FAULT_PLAN: {e}", file=sys.stderr)
+        return 2
+    if not plan:
+        print("empty plan (no faults)")
+        return 0
+    print(f"{len(plan)} fault(s):")
+    for f in plan:
+        who = "every process" if f.rank is None else f"process {f.rank}"
+        detail = ""
+        if f.kind == "hang":
+            detail = f" for {f.secs:g}s"
+        elif f.kind == "exit":
+            detail = f" with code {f.code}"
+        print(
+            f"  {f.kind:<5s} {who} after optimizer step {f.step}{detail}"
+        )
+    return 0
+
+
+def _cmd_corrupt_latest(args) -> int:
+    steps = faults.checkpoint_steps(args.directory)
+    if not steps:
+        print(
+            f"no committed checkpoints under {args.directory}",
+            file=sys.stderr,
+        )
+        return 1
+    target = faults.corrupt_latest_checkpoint(args.directory)
+    print(
+        f"truncated checkpoint step {steps[-1]} at {target} "
+        f"(remaining valid steps: {steps[:-1] or 'none'})"
+    )
+    return 0
+
+
+def _cmd_exit_codes(args) -> int:
+    rows = [
+        faults.classify_exit(rc)
+        for rc in (
+            faults.EXIT_OK,
+            faults.EXIT_NONFINITE,
+            faults.EXIT_TIMEOUT,
+            faults.EXIT_HUNG,
+            faults.EXIT_INTERRUPTED,
+            -9,   # SIGKILL (preemption / OOM-kill)
+            -15,  # SIGTERM
+            1,    # generic crash
+        )
+    ]
+    print(f"{'rc':>5s}  {'retryable':<9s}  reason")
+    for v in rows:
+        print(f"{v.rc:>5d}  {str(v.retryable).lower():<9s}  {v.reason}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="faultgen", description=__doc__.splitlines()[0]
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("validate", help="parse + pretty-print a FAULT_PLAN")
+    v.add_argument("plan")
+    v.set_defaults(fn=_cmd_validate)
+
+    c = sub.add_parser(
+        "corrupt-latest",
+        help="truncate the newest checkpoint (partial-write fault)",
+    )
+    c.add_argument("directory")
+    c.set_defaults(fn=_cmd_corrupt_latest)
+
+    e = sub.add_parser("exit-codes", help="print the exit-code taxonomy")
+    e.set_defaults(fn=_cmd_exit_codes)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Training child for the preemption / resume-equivalence oracles.
+
+Every rank of a ``launch.py`` world runs this: initialise the
+distributed backend, train ``loop.fit`` entirely from the env contract
+(MODEL/ENGINE/EPOCHS/MODEL_DIR/CHECKPOINT_EVERY_STEPS/FAULT_PLAN/...),
+then print a SHA-256 over the final parameters —
+``FT_PARAMS_SHA <rank> <hexdigest>`` — so the test can assert that a
+run killed mid-epoch and resumed by the restart supervisor ends
+bitwise-identical to an uninterrupted one (the ISSUE 4 acceptance
+criterion, riding the repo's determinism contract).
+"""
+
+import hashlib
+import sys
+
+from distributeddeeplearning_tpu.parallel import distributed
+
+
+def main() -> None:
+    distributed.maybe_initialize()
+
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data import make_dataset
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.training import loop
+
+    config = TrainConfig.from_env()
+    model = get_model(config.model, **config.model_kwargs())
+    result = loop.fit(
+        model, config, make_dataset(config, train=True),
+        add_default_logger=False,
+    )
+
+    # Bitwise param fingerprint. Params are replicated over the mesh in
+    # these oracles (dp engine; pjit on a data-only mesh), so the first
+    # addressable shard IS the full value on every process.
+    host_params = jax.tree.map(
+        lambda a: np.asarray(a.addressable_data(0)), result.state.params
+    )
+    digest = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves_with_path(host_params)
+    for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+        digest.update(str(path).encode())
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+    print(
+        f"FT_PARAMS_SHA {jax.process_index()} {digest.hexdigest()}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -315,10 +315,14 @@ def test_multislice_stop_reaches_all_nodes_despite_failure(
     fake_gcloud.set_rules([
         {"match": "ssh ms-0", "rc": 255, "stderr": "conn refused\n"},
     ])
-    rc = submit.main(["--env-file", str(envf), "stop", "--job", "j1"])
+    rc = submit.main([
+        "--env-file", str(envf), "--retry-delay", "0.01",
+        "stop", "--job", "j1",
+    ])
     assert rc == 255
     calls = [" ".join(c) for c in fake_gcloud.calls()]
-    assert any("ssh ms-0" in c for c in calls)
+    # the persistent failure was retried with backoff before giving up
+    assert sum("ssh ms-0" in c for c in calls) == 3
     assert any("ssh ms-1" in c for c in calls)  # still reached
 
 
@@ -334,11 +338,36 @@ def test_multislice_partial_launch_prints_cleanup_guidance(
         {"match": "ssh ms-1", "rc": 255, "stderr": "conn refused\n"},
     ])
     rc = submit.main([
-        "--env-file", str(envf), "run", "--detach", "--job", "j9", "x.py",
+        "--env-file", str(envf), "--retry-delay", "0.01",
+        "run", "--detach", "--job", "j9", "x.py",
     ])
     assert rc == 255
     err = capsys.readouterr().err
     assert "submit stop --job j9" in err and "ms-1" in err
+
+
+def test_submit_stream_retries_transient_ssh(fake_gcloud, tmp_path, capsys):
+    """The provisioner's ssh retry/backoff policy now covers submit's
+    stream/status/stop: a transiently-refused ssh (TPU-VM right after
+    creation) is retried instead of failing the action on attempt 1."""
+    envf = tmp_path / ".env"
+    envf.write_text("TPU_NAME=ddl-pod\nZONE=z\n")
+    fake_gcloud.set_rules([{
+        "match": "tpu-vm ssh",
+        "fail_times": 1,
+        "rc": 255,
+        "stderr": "conn refused\n",
+        "counter": str(tmp_path / "stream_counter"),
+    }])
+    rc = submit.main([
+        "--env-file", str(envf), "--retry-delay", "0.01",
+        "stream", "--job", "j1", "--no-follow",
+    ])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "gcloud attempt 1/3 failed (rc=255)" in err
+    calls = [" ".join(c) for c in fake_gcloud.calls()]
+    assert sum("tpu-vm ssh" in c for c in calls) == 2  # fail, then ok
 
 
 def test_multislice_stream_slice_out_of_range_rejected(tmp_path, capsys):
