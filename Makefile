@@ -23,8 +23,8 @@ PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        accum-memory fault-suite native provision setup submit stream status \
-        stop teardown
+        accum-memory fault-suite serve-bench native provision setup submit \
+        stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
 build:
@@ -84,6 +84,10 @@ recertify:	## all headline protocols at one HEAD -> RECERT.json (round 5)
 
 decode-audit:	## decode-tier roofline + batch sweep (round 5)
 	$(PY) scripts/decode_audit.py
+
+serve-bench:	## continuous batching vs sequential generate under Poisson
+	## load (docs/SERVING.md protocol; SERVE_*/BENCH_VOCAB knobs)
+	$(PY) scripts/serve_bench.py
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
 	$(PY) scripts/accum_memory.py

@@ -22,6 +22,16 @@ Usage::
     tokens = generate(model, state.params, prompt,   # [B, Tp] int32
                       max_new_tokens=64, temperature=0.8, top_k=40,
                       top_p=0.95, rng=jax.random.PRNGKey(0))
+
+**Serving**: this module is the *sequential reference path* — one
+compiled program per (shape, sampling config), the whole loop in one
+dispatch. Production traffic goes through the continuous-batching tier
+(``distributeddeeplearning_tpu.serving``): a slot-pool engine that
+co-decodes many requests per step with bucketed prefill and a request
+scheduler, built on the same decode-cache machinery
+(:func:`decode_variant` / :func:`decode_cache_shapes`) and
+bitwise-equal per request to this path. ``generate(engine=...)`` routes
+rows through a serving engine/server directly.
 """
 
 from __future__ import annotations
@@ -33,6 +43,26 @@ import jax.numpy as jnp
 from jax import lax
 
 PyTree = object
+
+
+def decode_variant(model):
+    """The model re-staged for KV-cache decoding (shared contract of
+    this module and ``serving.SlotEngine``): mutable-cache attention,
+    plain XLA einsum (decode is bandwidth-bound; Pallas/ring paths are
+    training shapes), no sequence axis."""
+    return model.clone(decode=True, attn_impl="xla", seq_axis=None)
+
+
+def decode_cache_shapes(decode_model, batch: int, length: int):
+    """Shape-only trace of the decode model's init: the KV-cache
+    pytree's ``ShapeDtypeStruct``s at ``[batch, length]`` — no
+    parameter initializers or forward compute ever run."""
+    return jax.eval_shape(
+        lambda r: decode_model.init(
+            r, jnp.zeros((batch, length), jnp.int32), train=False
+        ),
+        jax.random.PRNGKey(0),
+    )["cache"]
 
 
 def _sample(
@@ -100,9 +130,16 @@ def generate(
     eos_token: Optional[int] = None,
     pad_token: Optional[int] = None,
     rng: Optional[jax.Array] = None,
+    engine=None,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, Tp]
     int32). Returns ``[B, Tp + max_new_tokens]`` (prompt included).
+
+    ``engine``: a ``serving.SlotEngine`` or ``serving.Server`` — rows
+    are then served as continuous-batching requests on its slot pool
+    (one program regardless of shape/config) instead of compiling this
+    request-shaped scan; bitwise-equal at B=1, per-row keys at B>1
+    (``serving.generate_with_engine``).
 
     ``model`` is a trained ``TransformerLM`` (its ``decode`` field is
     overridden here); ``params`` the trained parameters (e.g.
@@ -124,6 +161,18 @@ def generate(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if engine is not None:
+        from distributeddeeplearning_tpu.serving import generate_with_engine
+
+        import numpy as np
+
+        return generate_with_engine(
+            engine, np.asarray(prompt, np.int32),
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token=eos_token,
+            pad_token=pad_token,
+            rng=None if rng is None else np.asarray(rng, np.uint32),
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, t_prompt = prompt.shape
@@ -147,22 +196,14 @@ def generate(
         cached = None
     if cached is not None:
         return cached(params, jnp.asarray(prompt, jnp.int32), rng)
-    decode_model = model.clone(decode=True, attn_impl="xla", seq_axis=None)
+    decode_model = decode_variant(model)
 
-    # Shape-only trace of init sizes the KV caches; the actual cache is
-    # just zeros of those shapes — no parameter initializers or forward
-    # compute ever run for it. Buffers are sized to THIS REQUEST
-    # (prompt + max_new_tokens), not model.max_seq_len: decode attention
-    # streams the whole static buffer every step (position-masked), so a
-    # 4k-context model generating 256 tokens would otherwise pay 16× the
-    # KV bytes — and decode is KV/weight-bandwidth-bound
-    # (scripts/decode_audit.py).
-    cache_shapes = jax.eval_shape(
-        lambda r: decode_model.init(
-            r, jnp.zeros((b, total), jnp.int32), train=False
-        ),
-        jax.random.PRNGKey(0),
-    )["cache"]
+    # Buffers are sized to THIS REQUEST (prompt + max_new_tokens), not
+    # model.max_seq_len: decode attention streams the whole static
+    # buffer every step (position-masked), so a 4k-context model
+    # generating 256 tokens would otherwise pay 16× the KV bytes — and
+    # decode is KV/weight-bandwidth-bound (scripts/decode_audit.py).
+    cache_shapes = decode_cache_shapes(decode_model, b, total)
 
     def run(params, prompt, rng):
         cache = jax.tree.map(

@@ -397,7 +397,11 @@ def launch_supervised(
     * exports ``OBS_PROC_SUFFIX=-r<k>`` + a distinct launcher identity so
       each attempt's event/flight files survive into one merged failure
       timeline (rendered by ``scripts/obs_report.py``);
-    * exports ``DDL_RESTART=<k>`` for anything that wants to know.
+    * exports ``DDL_RESTART=<k>`` for anything that wants to know;
+    * suffixes ``COMPILATION_CACHE_DIR`` per attempt (``<dir>-r<k>``)
+      when one is configured — same-host restarted worlds reusing one
+      persistent cache dir heap-corrupt this jax build (the r5 KNOWN
+      ISSUE), so each attempt compiles against its own dir.
 
     Non-retryable exits (success, the non-finite-loss guard's 121,
     timeout 124, operator interrupt 130) return immediately. The return
@@ -421,6 +425,15 @@ def launch_supervised(
         # One run id for every attempt: the supervisor owns the run.
         base_env["OBS_RUN_ID"] = run_id
         sbus = EventBus(directory=obs_dir, run_id=run_id, proc="supervisor")
+    # KNOWN ISSUE guard (r5, tests/test_fault_tolerance.py): this jax
+    # build's persistent compilation cache heap-corrupts (SIGABRT) when
+    # a restarted multi-process world on one host reuses the SAME cache
+    # dir concurrently with the previous attempt's entries. Restart
+    # attempts therefore get a per-attempt suffixed cache dir — cold
+    # cache, but alive — instead of leaving the footgun to docs.
+    cache_dir = base_env.get("COMPILATION_CACHE_DIR") or os.environ.get(
+        "COMPILATION_CACHE_DIR"
+    )
     attempt = 0
     try:
         while True:
@@ -429,6 +442,19 @@ def launch_supervised(
                 extra["OBS_PROC_SUFFIX"] = f"-r{attempt}"
                 extra["DDL_RESTART"] = str(attempt)
                 extra["RESUME"] = "True"  # resume from the newest checkpoint
+                if cache_dir:
+                    suffixed = f"{cache_dir.rstrip(os.sep)}-r{attempt}"
+                    extra["COMPILATION_CACHE_DIR"] = suffixed
+                    sink.write(
+                        f"supervisor: restart attempt {attempt} uses "
+                        f"compilation cache dir {suffixed} (same-dir reuse "
+                        "across restarted worlds corrupts this jax build)\n"
+                    )
+                    if sbus is not None:
+                        sbus.point(
+                            "cache_dir_suffixed", attempt=attempt,
+                            dir=suffixed,
+                        )
             if sbus is not None:
                 sbus.point("attempt_start", attempt=attempt)
                 sbus.flush()

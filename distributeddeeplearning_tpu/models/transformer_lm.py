@@ -139,7 +139,11 @@ class TransformerLM(nn.Module):
         elif self.decode:
             # Incremental decoding: these t tokens sit at absolute
             # positions [pos_index, pos_index+t). The counter lives in
-            # the cache collection beside the attention KV caches.
+            # the cache collection beside the attention KV caches. Like
+            # the attention cache_index it may be a scalar (lockstep
+            # batch, inference.generate) or a [B] vector of per-row
+            # positions (serving.SlotEngine) — the vector path gathers
+            # each row's positions independently.
             from jax import lax
 
             pidx = self.variable(
@@ -149,7 +153,15 @@ class TransformerLM(nn.Module):
                 pos_t = pos[:, :t]
             else:
                 start = pidx.value
-                pos_t = lax.dynamic_slice_in_dim(pos[0], start, t, axis=0)[None]
+                if jnp.ndim(start) == 0:
+                    pos_t = lax.dynamic_slice_in_dim(
+                        pos[0], start, t, axis=0
+                    )[None]
+                else:
+                    # [B, t, hidden]: row b reads pos[start[b] .. +t)
+                    pos_t = jnp.take(
+                        pos[0], start[:, None] + jnp.arange(t), axis=0
+                    )
                 pidx.value = start + t
         else:
             pos_t = pos[:, :t]

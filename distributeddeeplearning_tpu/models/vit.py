@@ -141,7 +141,14 @@ class Attention(nn.Module):
     def _decode_attention(self, q, k, v):
         """Single/few-token query against the growing KV cache. Static
         shapes throughout: the cache is full-length from init and a
-        position mask hides the not-yet-written tail."""
+        position mask hides the not-yet-written tail.
+
+        ``cache_index`` may be a scalar (``inference.generate``: the
+        whole batch decodes in lockstep) or a ``[B]`` vector of per-row
+        positions (``serving.SlotEngine``: each batch row is an
+        independent request slot at its own depth). The vector path
+        writes K/V per row and masks per row; the math per row is
+        identical to the scalar path at that row's position."""
         from jax import lax
 
         ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
@@ -155,8 +162,24 @@ class Attention(nn.Module):
             return dot_product_attention(q, k, v, causal=self.causal)
         t = q.shape[1]
         idx = ci.value
-        ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
-        cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        if jnp.ndim(idx) == 0:
+            ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+            # query i sits at absolute position idx+i; it may attend to
+            # all cache slots <= that position (causal + written-so-far
+            # in one)
+            q_pos = idx + jnp.arange(t)  # [t]
+            mask = None  # built below against k_pos
+        else:
+            # Per-row positions: write row b's K/V at idx[b] (a vmapped
+            # dynamic_update_slice lowers to a per-row scatter).
+            write = jax.vmap(
+                lambda c, u, i: lax.dynamic_update_slice(c, u, (i, 0, 0))
+            )
+            ck.value = write(ck.value, k, idx)
+            cv.value = write(cv.value, v, idx)
+            q_pos = idx[:, None] + jnp.arange(t)  # [B, t]
+            mask = None
         ci.value = idx + t
         k_all, v_all = ck.value, cv.value
         length = k_all.shape[1]
@@ -164,12 +187,12 @@ class Attention(nn.Module):
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", (q * head_dim**-0.5), k_all
         ).astype(jnp.float32)
-        # query i sits at absolute position idx+i; it may attend to all
-        # cache slots <= that position (causal + written-so-far in one)
-        q_pos = idx + jnp.arange(t)
         k_pos = jnp.arange(length)
-        mask = k_pos[None, :] <= q_pos[:, None]  # [t, length]
-        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+        if q_pos.ndim == 1:
+            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,t,L]
+        else:
+            mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 

@@ -148,6 +148,7 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
     counters: Dict[str, float] = {}
     sync_by_label: Dict[str, int] = {}
     gauges: Dict[str, float] = {}
+    gauge_means: Dict[str, List[float]] = {}  # name -> [sum, count]
     points: Dict[str, int] = {}
     procs: Dict[Any, Dict[str, Any]] = {}
     # name -> epoch -> {proc: end_wall}; cross-process skew is read off
@@ -182,6 +183,12 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
                 )
         elif kind == "gauge":
             gauges[name] = e.get("value")
+            try:
+                m = gauge_means.setdefault(name, [0.0, 0])
+                m[0] += float(e.get("value", 0.0))
+                m[1] += 1
+            except (TypeError, ValueError):
+                pass
         elif kind == "point":
             points[name] = points.get(name, 0) + 1
 
@@ -212,6 +219,31 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         v["total_s"] for k, v in span_stats.items() if "compile" in k
     )
     step_s = span_stats.get("step", {}).get("total_s", 0.0)
+
+    # Serving view (continuous-batching tier): how request time splits
+    # across queue-wait vs prefill vs batched decode, plus occupancy.
+    serving = None
+    if any(
+        k.startswith("serve.")
+        for k in (*span_stats, *counters, *points, *gauges)
+    ):
+        occ = gauge_means.get("serve.slot_occupancy")
+        serving = {
+            "requests_done": points.get("serve.request_done", 0),
+            "admitted": counters.get("serve.admitted", 0),
+            "completed": counters.get("serve.completed", 0),
+            "rejected": counters.get("serve.rejected", 0),
+            "deadline_evictions": counters.get("serve.evicted_deadline", 0),
+            "cancelled": counters.get("serve.cancelled", 0),
+            "tokens": counters.get("serve.tokens", 0),
+            "occupancy_mean": occ[0] / occ[1] if occ and occ[1] else None,
+            "queue_wait": span_stats.get("serve.queue_wait"),
+            "ttft": span_stats.get("serve.ttft"),
+            "prefill": span_stats.get("serve.prefill"),
+            "decode_step": span_stats.get("serve.decode_step"),
+            "request": span_stats.get("serve.request"),
+        }
+
     run_ids = {m.get("run") for m in loaded["metas"].values()}
     return {
         "run_ids": sorted(r for r in run_ids if r),
@@ -224,6 +256,7 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         "points": points,
         "compile_s": compile_s,
         "step_s": step_s,
+        "serving": serving,
         "max_epoch_skew_ms": max(skews) if skews else 0.0,
         "epochs_seen": len(epoch_ends),
     }
@@ -271,6 +304,34 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
     add("")
     add(f"compile vs step time: compile {summary['compile_s']:.3f}s, "
         f"step {summary['step_s']:.3f}s")
+    srv = summary.get("serving")
+    if srv:
+        add("")
+        add("serving (continuous batching):")
+        add(
+            f"  requests: {srv['requests_done']} done "
+            f"({srv['completed']:.0f} completed, "
+            f"{srv['deadline_evictions']:.0f} deadline, "
+            f"{srv['cancelled']:.0f} cancelled, "
+            f"{srv['rejected']:.0f} rejected), "
+            f"{srv['tokens']:.0f} tokens"
+        )
+        if srv["occupancy_mean"] is not None:
+            add(f"  slot occupancy (mean over working ticks): "
+                f"{srv['occupancy_mean']:.2f}")
+        # Per-request latency anatomy: where the time went.
+        for label, key in (
+            ("queue wait", "queue_wait"), ("ttft", "ttft"),
+            ("prefill", "prefill"), ("decode step", "decode_step"),
+            ("request total", "request"),
+        ):
+            s = srv.get(key)
+            if s:
+                add(
+                    f"  {label:14s} n={s['count']:<6d} "
+                    f"total {s['total_s']:8.3f}s  p50 {s['p50_ms']:8.2f}ms  "
+                    f"p99 {s['p99_ms']:8.2f}ms"
+                )
     if summary["epochs_seen"]:
         add(f"epochs: {summary['epochs_seen']}, max cross-process "
             f"epoch-end skew: {summary['max_epoch_skew_ms']:.1f} ms")

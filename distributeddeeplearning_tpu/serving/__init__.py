@@ -1,0 +1,45 @@
+"""Continuous-batching serving tier (request-level batching).
+
+The training stack scales batches across chips; this package scales
+*requests* across time on one set of chips. ``inference.generate`` is a
+one-request-at-a-time sampler — a server built on it would idle the
+accelerator between requests and recompile per prompt length. Here:
+
+* :class:`~.engine.SlotEngine` — one fixed-shape compiled decode step
+  over ``[num_slots]`` KV-cache slots; requests join/leave at step
+  granularity (iteration-level scheduling, Orca OSDI'22), each slot a
+  static row of the pooled cache (slot-granular KV management in the
+  spirit of vLLM's PagedAttention, block size = one request). No
+  recompile ever on admission/eviction.
+* Bucketed prefill — prompt lengths padded up a small bucket ladder;
+  one compiled prefill program per bucket, writing straight into the
+  assigned slot's cache rows.
+* :class:`~.scheduler.Server` — bounded admission queue with
+  backpressure, FIFO + prefill/decode interleave, per-request
+  deadline/cancel, graceful drain, instrumentation through the obs bus.
+
+Per-request output is **bitwise-identical** to sequential
+``inference.generate`` (greedy and seeded sampling) whatever the
+co-scheduling — ``tests/test_serving.py`` is the oracle.
+"""
+
+from distributeddeeplearning_tpu.serving.engine import (  # noqa: F401
+    ReqSpec,
+    SlotEngine,
+)
+from distributeddeeplearning_tpu.serving.keys import (  # noqa: F401
+    request_key_ladder,
+    split_key,
+)
+from distributeddeeplearning_tpu.serving.sampling import (  # noqa: F401
+    sample_slot,
+    sample_slots,
+)
+from distributeddeeplearning_tpu.serving.scheduler import (  # noqa: F401
+    QueueFull,
+    Request,
+    RequestHandle,
+    Server,
+    ServeConfig,
+    generate_with_engine,
+)

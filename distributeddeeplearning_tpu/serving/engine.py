@@ -1,0 +1,477 @@
+"""Slot-pool batched decode engine — the compiled heart of serving.
+
+One pooled KV cache of ``[num_slots, max_len, heads, head_dim]`` rows
+per attention layer, and exactly **bucket_count + 1 compiled programs**
+for the engine's whole lifetime:
+
+* one *decode step*: every occupied slot advances one token — per-slot
+  positions (vector ``cache_index``/``pos_index``, see
+  ``models/vit.Attention._decode_attention``), per-slot sampling config
+  as data (``serving.sampling``), per-slot stop detection on device.
+  Requests join and leave between steps; the program never changes.
+* one *prefill* per prompt-length bucket: the prompt padded up the
+  bucket ladder runs one full causal forward with a fresh zero cache
+  and writes K/V straight into the assigned slot's pool rows
+  (``dynamic_update_slice`` at the slot index — the padded tail beyond
+  ``prompt_len`` lands in rows the decode mask can never attend before
+  they are overwritten, so it needs no cleanup). The first token is
+  sampled inside the program from the true last prompt position.
+
+Static shapes everywhere; admission, eviction and any greedy/sampled
+request mix are pure data. Both programs are AOT-compiled
+(``.lower().compile()``, cache pool donated) at :meth:`SlotEngine.warmup`
+— after it, the engine *cannot* recompile, which
+``tests/test_serving.py`` pins with a backend-compile listener across
+an admission/eviction churn.
+
+Bitwise contract: each request's token stream equals sequential
+``inference.generate`` (same prompt, config and rng) — the per-request
+key ladder is precomputed on the host (``serving.keys``) and fed per
+step, so co-scheduling cannot perturb any request's randomness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.serving import keys as keylib
+from distributeddeeplearning_tpu.serving.sampling import (
+    DEFAULT_TOP_K_CAP,
+    sample_slot,
+    sample_slots,
+)
+from distributeddeeplearning_tpu.utils.logging import get_logger
+
+_INDEX_NAMES = ("cache_index", "pos_index")
+
+
+def default_buckets(max_len: int, smallest: int = 16) -> Tuple[int, ...]:
+    """Power-of-two prefill ladder up to ``max_len`` (always including
+    ``max_len`` itself so any admissible prompt has a bucket)."""
+    out: List[int] = []
+    b = smallest
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(sorted(set(out)))
+
+
+@dataclasses.dataclass
+class ReqSpec:
+    """One request's generation spec — mirrors ``inference.generate``'s
+    keyword surface; ``rng`` is raw key data ([2] uint32), an int seed,
+    or None (PRNGKey(0), like ``generate``)."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token: Optional[int] = None
+    rng: Any = None
+
+    def validate(self, max_len: int, max_bucket: int) -> None:
+        t = int(np.asarray(self.prompt).shape[-1])
+        if np.asarray(self.prompt).ndim != 1 or t < 1:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+        if t > max_bucket:
+            raise ValueError(
+                f"prompt length {t} exceeds the largest prefill bucket "
+                f"{max_bucket}"
+            )
+        if t + self.max_new_tokens > max_len:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {self.max_new_tokens} "
+                f"exceeds the engine cache length {max_len}"
+            )
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+
+    def key_data(self) -> np.ndarray:
+        if self.rng is None:
+            return keylib.key_from_seed(0)
+        if isinstance(self.rng, (int, np.integer)):
+            return keylib.key_from_seed(int(self.rng))
+        return np.asarray(self.rng, np.uint32).reshape(2)
+
+
+class SlotEngine:
+    """Continuous-batching decode over ``num_slots`` KV-cache slots.
+
+    Low-level and mechanical by design: it owns the device cache pool,
+    the compiled programs and per-slot decode bookkeeping. Queueing,
+    deadlines and request lifecycles live in
+    :class:`~distributeddeeplearning_tpu.serving.scheduler.Server`.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        num_slots: int = 8,
+        max_len: Optional[int] = None,
+        buckets: Optional[Tuple[int, ...]] = None,
+        top_k_cap: int = DEFAULT_TOP_K_CAP,
+    ) -> None:
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        model_max = getattr(model, "max_seq_len", None)
+        if max_len is None:
+            if model_max is None:
+                raise ValueError("max_len required for models without "
+                                 "max_seq_len")
+            max_len = int(model_max)
+        if model_max is not None and max_len > model_max:
+            raise ValueError(
+                f"max_len {max_len} exceeds model.max_seq_len {model_max}"
+            )
+        from distributeddeeplearning_tpu.inference import decode_variant
+
+        self.model = model
+        self.decode_model = decode_variant(model)
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        bs = tuple(sorted(set(int(b) for b in (buckets or default_buckets(max_len)))))
+        if not bs or bs[0] < 1:
+            raise ValueError(f"invalid bucket ladder {bs}")
+        if bs[-1] > max_len:
+            raise ValueError(
+                f"largest bucket {bs[-1]} exceeds max_len {max_len}"
+            )
+        self.buckets = bs
+        if top_k_cap < 1:
+            raise ValueError(f"top_k_cap must be >= 1, got {top_k_cap}")
+        self.top_k_cap = int(top_k_cap)
+        # Params live on device once; an already-placed (possibly
+        # TP/FSDP-sharded) tree is kept as-is so GSPMD decodes in place.
+        leaves = jax.tree.leaves(params)
+        if leaves and all(isinstance(l, jax.Array) for l in leaves):
+            self.params = params
+        else:
+            self.params = jax.device_put(params)
+
+        # Cache pool template: shape-only trace of the decode model's
+        # init at [num_slots, max_len] (no parameter initializers run).
+        from distributeddeeplearning_tpu.inference import decode_cache_shapes
+
+        tmpl = decode_cache_shapes(
+            self.decode_model, self.num_slots, self.max_len
+        )
+        from flax import traverse_util
+        from flax.core import unfreeze
+
+        self._flatten = traverse_util.flatten_dict
+        self._unflatten = traverse_util.unflatten_dict
+        self._unfreeze = unfreeze
+        self._template = self._flatten(unfreeze(tmpl))
+        for path, leaf in self._template.items():
+            if path[-1] not in _INDEX_NAMES and leaf.ndim < 2:
+                raise ValueError(f"unexpected cache leaf {path}: {leaf}")
+
+        # Host-side slot state (the scheduler-visible mirror of the
+        # device pool; positions are re-fed every step, so the device
+        # copies are never authoritative).
+        s = self.num_slots
+        self._active = np.zeros(s, bool)
+        self._tokens = np.zeros(s, np.int32)
+        self._positions = np.zeros(s, np.int32)
+        self._temps = np.zeros(s, np.float32)
+        self._top_ks = np.zeros(s, np.int32)
+        self._top_ps = np.zeros(s, np.float32)
+        self._eos = np.full(s, -1, np.int32)
+        self._ladders: List[Optional[np.ndarray]] = [None] * s
+        self._cursor = np.zeros(s, np.int64)
+
+        self._pool = None
+        self._decode_exec = None
+        self._prefill_exec: Dict[int, Any] = {}
+        self.compile_count = 0
+        self.compile_sec = 0.0
+        self.decode_steps = 0
+
+    # -- cache plumbing ----------------------------------------------------
+
+    def _zero_cache(self, batch: int):
+        return self._unflatten({
+            path: jnp.zeros(
+                ((batch,) + leaf.shape[1:]) if leaf.ndim else (), leaf.dtype
+            )
+            for path, leaf in self._template.items()
+        })
+
+    def _with_positions(self, cache, positions):
+        flat = self._flatten(self._unfreeze(cache))
+        return self._unflatten({
+            path: (positions if path[-1] in _INDEX_NAMES else leaf)
+            for path, leaf in flat.items()
+        })
+
+    # -- traced programs ---------------------------------------------------
+
+    def _decode_fn(
+        self, params, cache, tokens, positions, step_keys, temps, top_ks,
+        top_ps, eos,
+    ):
+        cache = self._with_positions(cache, positions)
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, None],
+            train=False,
+            mutable=["cache"],
+        )
+        nxt = sample_slots(
+            logits[:, -1], step_keys, temps, top_ks, top_ps,
+            top_k_cap=self.top_k_cap,
+        )
+        eos_hit = (nxt == eos) & (eos >= 0)
+        return self._unfreeze(mutated["cache"]), nxt, eos_hit
+
+    def _prefill_fn(
+        self, params, pool, slot, tokens, prompt_len, key, temp, top_k,
+        top_p, eos,
+    ):
+        # Fresh zero cache, scalar index 0: the prompt's forward IS the
+        # lockstep decode path inference.generate runs — same K/V, same
+        # logits at every prompt position.
+        fresh = self._with_positions(
+            self._zero_cache(1), jnp.zeros((), jnp.int32)
+        )
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": fresh},
+            tokens,
+            train=False,
+            mutable=["cache"],
+        )
+        last = lax.dynamic_index_in_dim(
+            logits[0], prompt_len - 1, axis=0, keepdims=False
+        )
+        first = sample_slot(last, key, temp, top_k, top_p, self.top_k_cap)
+        eos_hit = (first == eos) & (eos >= 0)
+        mflat = self._flatten(self._unfreeze(mutated["cache"]))
+        pflat = self._flatten(self._unfreeze(pool))
+        out = {
+            path: (
+                lax.dynamic_update_slice(
+                    leaf, mflat[path], (slot,) + (0,) * (leaf.ndim - 1)
+                )
+                if path[-1] not in _INDEX_NAMES
+                else leaf
+            )
+            for path, leaf in pflat.items()
+        }
+        return self._unflatten(out), first, eos_hit
+
+    # -- compilation -------------------------------------------------------
+
+    def warmup(self) -> Dict[str, float]:
+        """AOT-compile the decode step and every bucket's prefill
+        (idempotent). After this the engine's program set is closed:
+        ``compile_count == len(buckets) + 1`` for its whole lifetime."""
+        log = get_logger()
+        t_all = time.perf_counter()
+        if self._pool is None:
+            # Canonical pool layout: index leaves are [num_slots]
+            # vectors (the decode step's per-slot positions) so every
+            # program — prefill passes them through, decode rewrites
+            # them — sees one stable signature. Each leaf gets its OWN
+            # buffer: the pool is donated, and donating one aliased
+            # buffer through several leaves is an XLA error.
+            self._pool = jax.device_put(self._unflatten({
+                path: jnp.zeros(
+                    (self.num_slots,) + (
+                        leaf.shape[1:] if path[-1] not in _INDEX_NAMES
+                        else ()
+                    ),
+                    jnp.int32 if path[-1] in _INDEX_NAMES else leaf.dtype,
+                )
+                for path, leaf in self._template.items()
+            }))
+        s = self.num_slots
+        if self._decode_exec is None:
+            with obs.span("compile", what="serve_decode", slots=s):
+                t0 = time.perf_counter()
+                self._decode_exec = (
+                    jax.jit(self._decode_fn, donate_argnums=(1,))
+                    .lower(
+                        self.params, self._pool,
+                        np.zeros(s, np.int32), np.zeros(s, np.int32),
+                        np.zeros((s, 2), np.uint32), np.zeros(s, np.float32),
+                        np.zeros(s, np.int32), np.zeros(s, np.float32),
+                        np.full(s, -1, np.int32),
+                    )
+                    .compile()
+                )
+                self.compile_sec += time.perf_counter() - t0
+            self.compile_count += 1
+        for bucket in self.buckets:
+            if bucket in self._prefill_exec:
+                continue
+            with obs.span("compile", what=f"serve_prefill_b{bucket}"):
+                t0 = time.perf_counter()
+                self._prefill_exec[bucket] = (
+                    jax.jit(self._prefill_fn, donate_argnums=(1,))
+                    .lower(
+                        self.params, self._pool,
+                        np.int32(0), np.zeros((1, bucket), np.int32),
+                        np.int32(1), np.zeros(2, np.uint32),
+                        np.float32(0), np.int32(0), np.float32(0),
+                        np.int32(-1),
+                    )
+                    .compile()
+                )
+                self.compile_sec += time.perf_counter() - t0
+            self.compile_count += 1
+        info = {
+            "compile_sec": self.compile_sec,
+            "programs": float(self.compile_count),
+        }
+        log.info(
+            "serve warmup: %d programs (decode + %d prefill buckets %s) "
+            "in %.2fs, slots=%d cache_len=%d",
+            self.compile_count, len(self.buckets), list(self.buckets),
+            time.perf_counter() - t_all, s, self.max_len,
+        )
+        obs.gauge("serve.programs", float(self.compile_count))
+        return info
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    @property
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.num_slots) if not self._active[i]]
+
+    @property
+    def active_slots(self) -> List[int]:
+        return [i for i in range(self.num_slots) if self._active[i]]
+
+    @property
+    def occupancy(self) -> float:
+        return float(self._active.sum()) / self.num_slots
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    def validate_spec(self, spec: ReqSpec) -> int:
+        """Full admission validation (shape limits + the sort-free
+        sampling cap) — called by ``Server.submit`` so a malformed
+        request fails the *submitting* caller, never the serving loop.
+        Returns the effective top_k (``top_k >= vocab`` maps to 0 =
+        filter off, the reference's clamp — same draw)."""
+        spec.validate(self.max_len, self.buckets[-1])
+        tk = int(spec.top_k or 0)
+        vocab = getattr(self.model, "vocab_size", None)
+        if tk and vocab is not None and tk >= int(vocab):
+            tk = 0
+        if tk > self.top_k_cap and spec.top_p is None:
+            # Without nucleus sampling the request runs the sort-free
+            # path, whose static lax.top_k window is the cap.
+            raise ValueError(
+                f"top_k {tk} exceeds the engine's sort-free cap "
+                f"{self.top_k_cap}; raise SlotEngine(top_k_cap=...) / "
+                "SERVE_TOP_K_CAP"
+            )
+        return tk
+
+    def prefill(self, slot: int, spec: ReqSpec) -> Tuple[int, bool]:
+        """Admit ``spec`` into ``slot``: run the bucketed prefill, seat
+        the request's sampling state, and return (first token, eos hit).
+        The slot is occupied afterwards even on an immediate eos — the
+        caller decides to :meth:`release`."""
+        if self._active[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        tk = self.validate_spec(spec)
+        if self._decode_exec is None:
+            self.warmup()
+        prompt = np.asarray(spec.prompt, np.int32).reshape(-1)
+        t = prompt.shape[0]
+        bucket = self.bucket_for(t)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :t] = prompt
+        sampled = spec.temperature > 0.0
+        ladder = (
+            keylib.request_key_ladder(spec.key_data(), spec.max_new_tokens)
+            if sampled
+            else None
+        )
+        key0 = ladder[0] if sampled else np.zeros(2, np.uint32)
+        temp = np.float32(spec.temperature if sampled else 0.0)
+        top_k = np.int32(tk)
+        top_p = np.float32(spec.top_p or 0.0)
+        eos = np.int32(-1 if spec.eos_token is None else spec.eos_token)
+        self._pool, first, eos_hit = self._prefill_exec[bucket](
+            self.params, self._pool, np.int32(slot), padded, np.int32(t),
+            np.asarray(key0, np.uint32), temp, top_k, top_p, eos,
+        )
+        self._active[slot] = True
+        self._tokens[slot] = int(first)
+        self._positions[slot] = t
+        self._temps[slot] = temp
+        self._top_ks[slot] = top_k
+        self._top_ps[slot] = top_p
+        self._eos[slot] = eos
+        self._ladders[slot] = ladder
+        self._cursor[slot] = 1
+        return int(first), bool(eos_hit)
+
+    def decode_step(self) -> List[Tuple[int, int, bool]]:
+        """One batched decode tick: every occupied slot emits its next
+        token. Returns ``[(slot, token, eos_hit), ...]`` for occupied
+        slots (empty when the pool is idle)."""
+        slots = self.active_slots
+        if not slots:
+            return []
+        step_keys = np.zeros((self.num_slots, 2), np.uint32)
+        for i in slots:
+            ladder = self._ladders[i]
+            if ladder is not None:
+                step_keys[i] = ladder[min(self._cursor[i], len(ladder) - 1)]
+        self._pool, nxt, eos_hit = self._decode_exec(
+            self.params, self._pool, self._tokens, self._positions,
+            step_keys, self._temps, self._top_ks, self._top_ps, self._eos,
+        )
+        nxt = np.array(nxt)
+        eos_hit = np.array(eos_hit)
+        self.decode_steps += 1
+        out = []
+        for i in slots:
+            self._tokens[i] = nxt[i]
+            self._positions[i] += 1
+            self._cursor[i] += 1
+            out.append((i, int(nxt[i]), bool(eos_hit[i])))
+        return out
+
+    def release(self, slot: int) -> None:
+        """Free a slot (eviction). Pure host bookkeeping — the stale
+        cache rows are unreachable (per-slot position masks) and fully
+        overwritten by the next prefill into this slot."""
+        self._active[slot] = False
+        self._ladders[slot] = None
+        self._tokens[slot] = 0
+        self._positions[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 0.0
+        self._eos[slot] = -1
+        self._cursor[slot] = 0

@@ -1,0 +1,124 @@
+"""Per-slot, data-driven token sampling for the batched decode step.
+
+``inference._sample`` specialises the compiled program on the sampling
+config (temperature / top-k / top-p are Python values). A slot engine
+cannot: every admission would recompile the decode step. Here the knobs
+are **per-slot data** — ``[num_slots]`` vectors fed each step — so one
+compiled program serves any mix of greedy and sampled requests, and the
+disabled sentinels (``temperature <= 0`` = greedy, ``top_k == 0`` /
+``top_p == 0`` = filter off) are resolved with ``where`` selects, not
+Python branches.
+
+Performance shape: a full-vocab **sort is only paid when some slot
+actually runs nucleus sampling** — a batch-level ``lax.cond`` (legal
+on data: both branches are traced into the one program, one executes)
+routes greedy/top-k traffic through ``lax.top_k`` at a static
+``top_k_cap`` instead (decode at 32k vocab is otherwise dominated by
+8× per-slot sorts, not the model). This mirrors the reference's own
+top-k fast path.
+
+Bitwise contract: for any one slot, the emitted token equals what
+``inference._sample`` produces for the same ``[1, vocab]`` logits row,
+key and config (``tests/test_serving.py`` sweeps the config matrix).
+That holds because every numeric step mirrors the reference — same f32
+upcast and temperature divide, the k-th threshold *by value* (the k-th
+largest is the same number whether ``lax.top_k`` or a sort finds it),
+the nucleus keep-rule computed on the *unfiltered* sorted distribution,
+filters composed in the same order, and the categorical draw made with
+the same ``[1, vocab]`` operand shape so the per-lane threefry bits are
+identical under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Largest per-request top_k the sort-free path serves; requests above it
+# (and below vocab) are rejected at admission with a pointer to this
+# knob (SlotEngine(top_k_cap=...) / SERVE_TOP_K_CAP). top_k >= vocab
+# keeps every token — the reference clamps it, so admission maps it to
+# "filter off" and parity is preserved.
+DEFAULT_TOP_K_CAP = 128
+
+
+def _scale(logits, temperature):
+    return logits.astype(jnp.float32) / jnp.where(
+        temperature > 0, temperature, 1.0
+    )
+
+
+def _draw(out, key, temperature, greedy):
+    sampled = jax.random.categorical(key, out[None, :], axis=-1)[0].astype(
+        jnp.int32
+    )
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def _row_topk(logits, key, temperature, top_k, top_k_cap):
+    """Sort-free row sampler (greedy / top-k): threshold from
+    ``lax.top_k`` at the static cap — same k-th *value* as a sort."""
+    neg_inf = jnp.finfo(jnp.float32).min
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    scaled = _scale(logits, temperature)
+    cap = min(top_k_cap, scaled.shape[-1])
+    top_vals = lax.top_k(scaled, cap)[0]
+    kth = top_vals[jnp.clip(top_k, 1, cap) - 1]
+    out = jnp.where(top_k > 0, jnp.where(scaled < kth, neg_inf, scaled),
+                    scaled)
+    return _draw(out, key, temperature, greedy)
+
+
+def _row_full(logits, key, temperature, top_k, top_p):
+    """Full-sort row sampler (any config, needed once nucleus filtering
+    is in play): one descending sort serves both filters."""
+    neg_inf = jnp.finfo(jnp.float32).min
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    scaled = _scale(logits, temperature)
+    vocab = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled)[::-1]
+    kth = sorted_desc[jnp.clip(top_k, 1, vocab) - 1]
+    out = jnp.where(top_k > 0, jnp.where(scaled < kth, neg_inf, scaled),
+                    scaled)
+    # Nucleus rule on the UNFILTERED sorted distribution (reference
+    # behaviour): keep tokens while the mass before them is < p.
+    probs = jax.nn.softmax(sorted_desc)
+    cum = jnp.cumsum(probs)
+    keep_sorted = (cum - probs) < top_p
+    threshold = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf))
+    filtered_p = jnp.where(out < threshold, neg_inf, out)
+    out = jnp.where(top_p > 0, filtered_p, out)
+    return _draw(out, key, temperature, greedy)
+
+
+def sample_slot(logits, key, temperature, top_k, top_p,
+                top_k_cap: int = DEFAULT_TOP_K_CAP):
+    """One slot's next token from ``[vocab]`` logits.
+
+    ``temperature <= 0`` → greedy argmax (key unused). ``top_k == 0`` /
+    ``top_p == 0`` disable the respective filter; active values follow
+    ``inference._sample`` semantics (filters compose, intersection).
+    All three are traced scalars — no recompilation across requests.
+    """
+    return lax.cond(
+        top_p > 0,
+        lambda: _row_full(logits, key, temperature, top_k, top_p),
+        lambda: _row_topk(logits, key, temperature, top_k, top_k_cap),
+    )
+
+
+def sample_slots(logits, keys, temperatures, top_ks, top_ps,
+                 top_k_cap: int = DEFAULT_TOP_K_CAP):
+    """Vectorised sampler over the slot axis: ``[S, vocab]`` logits +
+    per-slot ``[S]`` configs → ``[S]`` tokens. The batch-level cond
+    keeps the sort out of the program's hot path whenever no occupied
+    slot runs nucleus sampling."""
+    return lax.cond(
+        jnp.any(top_ps > 0),
+        lambda: jax.vmap(_row_full)(logits, keys, temperatures, top_ks,
+                                    top_ps),
+        lambda: jax.vmap(
+            lambda l, k, t, tk: _row_topk(l, k, t, tk, top_k_cap)
+        )(logits, keys, temperatures, top_ks),
+    )

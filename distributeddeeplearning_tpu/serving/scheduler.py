@@ -1,0 +1,437 @@
+"""Request scheduler over the slot engine — queue, policy, lifecycle.
+
+The serving loop the north star asks for ("heavy traffic from millions
+of users") in one process: a bounded FIFO admission queue with
+backpressure, iteration-level scheduling (admit into free slots between
+decode steps, at most ``prefills_per_step`` prefills per tick so a
+burst of arrivals cannot starve running streams), per-request deadlines
+and cancellation, and graceful drain. Every phase is instrumented
+through the obs bus:
+
+spans   ``serve.prefill`` (labels: bucket, slot, prompt_len),
+        ``serve.decode_step`` (label: active),
+        ``serve.queue_wait`` / ``serve.ttft`` / ``serve.request``
+        (measured durations — queue-wait, time-to-first-token, total)
+gauges  ``serve.slot_occupancy``, ``serve.queue_depth``,
+        ``serve.programs``
+counters ``serve.admitted``, ``serve.completed``, ``serve.tokens``,
+        ``serve.rejected``, ``serve.evicted_deadline``,
+        ``serve.cancelled``
+points  ``serve.request_done`` (req, reason, ttft_ms, tokens)
+
+Env contract (``ServeConfig.from_env``; docs/ORCHESTRATION.md):
+``SERVE_SLOTS``, ``SERVE_BUCKETS``, ``SERVE_QUEUE_DEPTH``,
+``SERVE_DEADLINE_MS``, ``SERVE_PREFILLS_PER_STEP``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.serving.engine import ReqSpec, SlotEngine
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the bounded admission queue is at capacity."""
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Engine + scheduler knobs, env-overridable (SERVE_*)."""
+
+    num_slots: int = 8
+    buckets: Optional[Tuple[int, ...]] = None
+    queue_depth: int = 64
+    deadline_ms: Optional[float] = None
+    prefills_per_step: int = 1
+    top_k_cap: int = 128
+
+    @classmethod
+    def from_env(cls, env=None) -> "ServeConfig":
+        e = os.environ if env is None else env
+        buckets = None
+        if e.get("SERVE_BUCKETS"):
+            buckets = tuple(
+                int(b) for b in str(e["SERVE_BUCKETS"]).split(",") if b.strip()
+            )
+        deadline = e.get("SERVE_DEADLINE_MS")
+        return cls(
+            num_slots=int(e.get("SERVE_SLOTS", cls.num_slots)),
+            buckets=buckets,
+            queue_depth=int(e.get("SERVE_QUEUE_DEPTH", cls.queue_depth)),
+            deadline_ms=float(deadline) if deadline else None,
+            prefills_per_step=int(
+                e.get("SERVE_PREFILLS_PER_STEP", cls.prefills_per_step)
+            ),
+            top_k_cap=int(e.get("SERVE_TOP_K_CAP", cls.top_k_cap)),
+        )
+
+
+@dataclasses.dataclass
+class Request:
+    """What a client submits. ``rng`` follows ``inference.generate``:
+    raw PRNG key data, an int seed, or None (PRNGKey(0))."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token: Optional[int] = None
+    rng: Any = None
+    deadline_ms: Optional[float] = None
+
+    def spec(self) -> ReqSpec:
+        return ReqSpec(
+            prompt=np.asarray(self.prompt, np.int32).reshape(-1),
+            max_new_tokens=int(self.max_new_tokens),
+            temperature=float(self.temperature),
+            top_k=self.top_k,
+            top_p=self.top_p,
+            eos_token=self.eos_token,
+            rng=self.rng,
+        )
+
+
+class RequestHandle:
+    """Client-side view of one submitted request.
+
+    ``status``: queued → running → one of done / deadline / cancelled.
+    ``result()`` blocks until finished and returns prompt + generated
+    tokens (up to and including eos when one was hit).
+    """
+
+    def __init__(self, req: Request, req_id: int, now: float) -> None:
+        self.request = req
+        self.id = req_id
+        self.status = "queued"
+        self.finish_reason: Optional[str] = None
+        self.new_tokens: List[int] = []
+        self.submitted_t = now
+        self.queue_wait_s: Optional[float] = None
+        self.ttft_s: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.done = threading.Event()
+        self._cancel = False
+        self._deadline_t = (
+            now + req.deadline_ms / 1e3 if req.deadline_ms is not None
+            else None
+        )
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return np.concatenate([
+            np.asarray(self.request.prompt, np.int32).reshape(-1),
+            np.asarray(self.new_tokens, np.int32),
+        ])
+
+    def cancel(self) -> None:
+        self._cancel = True
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still {self.status}")
+        return self.tokens
+
+    def expired(self, now: float) -> bool:
+        return self._deadline_t is not None and now > self._deadline_t
+
+
+class Server:
+    """Continuous-batching serving loop over a :class:`SlotEngine`.
+
+    Single-pumper model: exactly one thread drives :meth:`step` (or
+    :meth:`drain` / :meth:`serve_forever`); ``submit``/``cancel`` are
+    safe from any thread. Each tick: reap deadlines/cancels → admit up
+    to ``prefills_per_step`` queued requests into free slots (bucketed
+    prefill) → one batched decode step → deliver tokens and evict
+    finished slots.
+    """
+
+    def __init__(
+        self,
+        engine: SlotEngine,
+        *,
+        queue_depth: int = 64,
+        prefills_per_step: int = 1,
+        default_deadline_ms: Optional[float] = None,
+    ) -> None:
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        if prefills_per_step < 1:
+            raise ValueError(
+                f"prefills_per_step must be >= 1, got {prefills_per_step}"
+            )
+        self.engine = engine
+        self.queue_depth = queue_depth
+        self.prefills_per_step = prefills_per_step
+        self.default_deadline_ms = default_deadline_ms
+        self._lock = threading.Lock()
+        self._queue: Deque[RequestHandle] = collections.deque()
+        self._ids = itertools.count()
+        self._by_slot: Dict[int, RequestHandle] = {}
+        self._closed = False
+        self.stats: Dict[str, Any] = {
+            "admitted": 0, "completed": 0, "rejected": 0, "cancelled": 0,
+            "deadline": 0, "tokens": 0, "decode_steps": 0,
+            "occupancy_sum": 0.0, "occupancy_samples": 0,
+        }
+
+    @classmethod
+    def build(cls, model, params, config: Optional[ServeConfig] = None,
+              **engine_kw) -> "Server":
+        """Engine + server from one :class:`ServeConfig` (env-driven by
+        default)."""
+        cfg = config or ServeConfig.from_env()
+        engine = SlotEngine(
+            model, params, num_slots=cfg.num_slots, buckets=cfg.buckets,
+            top_k_cap=cfg.top_k_cap, **engine_kw,
+        )
+        return cls(
+            engine,
+            queue_depth=cfg.queue_depth,
+            prefills_per_step=cfg.prefills_per_step,
+            default_deadline_ms=cfg.deadline_ms,
+        )
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, request: Request) -> RequestHandle:
+        """Enqueue one request (validated eagerly so a malformed request
+        fails the caller, not the serving loop). Raises
+        :class:`QueueFull` when the bounded queue is at capacity — the
+        backpressure signal a front-end turns into HTTP 429."""
+        if self._closed:
+            raise RuntimeError("server is closed")
+        if request.deadline_ms is None and self.default_deadline_ms:
+            request = dataclasses.replace(
+                request, deadline_ms=self.default_deadline_ms
+            )
+        self.engine.validate_spec(request.spec())
+        now = time.monotonic()
+        with self._lock:
+            if len(self._queue) >= self.queue_depth:
+                self.stats["rejected"] += 1
+                obs.counter("serve.rejected")
+                raise QueueFull(
+                    f"admission queue at capacity ({self.queue_depth})"
+                )
+            handle = RequestHandle(request, next(self._ids), now)
+            self._queue.append(handle)
+            obs.gauge("serve.queue_depth", float(len(self._queue)))
+        return handle
+
+    # -- serving loop ------------------------------------------------------
+
+    def _finish(self, handle: RequestHandle, reason: str) -> None:
+        now = time.monotonic()
+        handle.status = "done" if reason in ("eos", "length") else reason
+        handle.finish_reason = reason
+        handle.finished_t = now
+        if reason in ("eos", "length"):
+            self.stats["completed"] += 1
+            obs.counter("serve.completed")
+        obs.span_event(
+            "serve.request", now - handle.submitted_t, t=handle.submitted_t,
+            req=handle.id, reason=reason, tokens=len(handle.new_tokens),
+        )
+        obs.point(
+            "serve.request_done", req=handle.id, reason=reason,
+            tokens=len(handle.new_tokens),
+            ttft_ms=None if handle.ttft_s is None else round(
+                handle.ttft_s * 1e3, 3
+            ),
+        )
+        handle.done.set()
+
+    def _reap(self, now: float) -> None:
+        """Deadline/cancel sweep over the queue and the active slots."""
+        with self._lock:
+            keep: Deque[RequestHandle] = collections.deque()
+            for h in self._queue:
+                if h._cancel:
+                    self.stats["cancelled"] += 1
+                    obs.counter("serve.cancelled")
+                    self._finish(h, "cancelled")
+                elif h.expired(now):
+                    self.stats["deadline"] += 1
+                    obs.counter("serve.evicted_deadline")
+                    self._finish(h, "deadline")
+                else:
+                    keep.append(h)
+            self._queue = keep
+        for slot, h in list(self._by_slot.items()):
+            if h._cancel or h.expired(now):
+                reason = "cancelled" if h._cancel else "deadline"
+                self.stats["cancelled" if h._cancel else "deadline"] += 1
+                obs.counter(
+                    "serve.cancelled" if h._cancel
+                    else "serve.evicted_deadline"
+                )
+                self.engine.release(slot)
+                del self._by_slot[slot]
+                self._finish(h, reason)
+
+    def _admit(self, now: float) -> None:
+        admitted = 0
+        while admitted < self.prefills_per_step:
+            free = self.engine.free_slots
+            if not free:
+                return
+            with self._lock:
+                if not self._queue:
+                    return
+                handle = self._queue.popleft()
+                obs.gauge("serve.queue_depth", float(len(self._queue)))
+            slot = free[0]
+            handle.queue_wait_s = now - handle.submitted_t
+            obs.span_event(
+                "serve.queue_wait", handle.queue_wait_s,
+                t=handle.submitted_t, req=handle.id,
+            )
+            spec = handle.request.spec()
+            with obs.span(
+                "serve.prefill", bucket=self.engine.bucket_for(
+                    spec.prompt.shape[0]
+                ), slot=slot, prompt_len=int(spec.prompt.shape[0]),
+            ):
+                first, eos_hit = self.engine.prefill(slot, spec)
+            handle.status = "running"
+            handle.ttft_s = time.monotonic() - handle.submitted_t
+            obs.span_event("serve.ttft", handle.ttft_s,
+                           t=handle.submitted_t, req=handle.id)
+            handle.new_tokens.append(first)
+            self.stats["admitted"] += 1
+            self.stats["tokens"] += 1
+            obs.counter("serve.admitted")
+            obs.counter("serve.tokens")  # the prefill-sampled first token
+            admitted += 1
+            if eos_hit or len(handle.new_tokens) >= spec.max_new_tokens:
+                self.engine.release(slot)
+                self._finish(handle, "eos" if eos_hit else "length")
+            else:
+                self._by_slot[slot] = handle
+
+    def step(self) -> bool:
+        """One scheduler tick. Returns True while work remains (active
+        slots or queued requests)."""
+        now = time.monotonic()
+        self._reap(now)
+        self._admit(now)
+        if self._by_slot:
+            with obs.span("serve.decode_step", active=len(self._by_slot)):
+                emitted = self.engine.decode_step()
+            self.stats["decode_steps"] += 1
+            for slot, token, eos_hit in emitted:
+                h = self._by_slot.get(slot)
+                if h is None:
+                    continue
+                h.new_tokens.append(token)
+                self.stats["tokens"] += 1
+                if eos_hit or len(h.new_tokens) >= h.request.max_new_tokens:
+                    self.engine.release(slot)
+                    del self._by_slot[slot]
+                    self._finish(h, "eos" if eos_hit else "length")
+            obs.counter("serve.tokens", len(emitted))
+        with self._lock:
+            busy = bool(self._by_slot or self._queue)
+        if busy:
+            # Occupancy is sampled on working ticks only — idle polling
+            # between arrivals would dilute the mean to meaninglessness.
+            occ = self.engine.occupancy
+            self.stats["occupancy_sum"] += occ
+            self.stats["occupancy_samples"] += 1
+            obs.gauge("serve.slot_occupancy", occ)
+        return busy
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful drain: pump until every queued + active request has
+        finished (admissions keep flowing; callers stop submitting)."""
+        t0 = time.monotonic()
+        while self.step():
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError("drain timed out with work remaining")
+
+    def serve_forever(self, stop: threading.Event,
+                      idle_sleep_s: float = 0.001) -> None:
+        """Pump loop for a background serving thread: steps while work
+        exists, naps briefly when idle, drains once ``stop`` is set."""
+        while not stop.is_set():
+            if not self.step():
+                time.sleep(idle_sleep_s)
+        self.drain()
+
+    def close(self) -> None:
+        """Stop accepting, drain what was already admitted or queued."""
+        self._closed = True
+        self.drain()
+
+    @property
+    def occupancy_mean(self) -> float:
+        n = self.stats["occupancy_samples"]
+        return self.stats["occupancy_sum"] / n if n else 0.0
+
+
+def generate_with_engine(
+    server_or_engine,
+    prompt: np.ndarray,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_token: Optional[int] = None,
+    pad_token: Optional[int] = None,
+    rng: Any = None,
+) -> np.ndarray:
+    """``inference.generate``'s signature served by the slot engine:
+    each row of ``prompt`` ([B, Tp] int32) becomes one request; rows
+    co-decode in the pool and the result is reassembled to
+    ``[B, Tp + max_new_tokens]`` (eos freezes a row to ``pad_token``,
+    like ``generate``).
+
+    Row 0 uses ``rng`` directly, so at B=1 the output is bitwise-equal
+    to sequential ``generate``; rows b>0 sample under
+    ``fold_in(rng, b)`` (``generate`` draws all rows from one key per
+    step, which has no per-row equivalent).
+    """
+    from distributeddeeplearning_tpu.serving import keys as keylib
+
+    if isinstance(server_or_engine, Server):
+        server = server_or_engine
+    else:
+        server = Server(server_or_engine)
+    prompt = np.asarray(prompt, np.int32)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [B, Tp], got {prompt.shape}")
+    if eos_token is not None and pad_token is None:
+        pad_token = eos_token
+    base_key = ReqSpec(
+        prompt=prompt[0], max_new_tokens=max_new_tokens, rng=rng
+    ).key_data()
+    handles = []
+    for b in range(prompt.shape[0]):
+        row_key = base_key if b == 0 else keylib.fold_key(base_key, b)
+        handles.append(server.submit(Request(
+            prompt=prompt[b], max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token=eos_token, rng=row_key,
+        )))
+    server.drain()
+    out = np.full(
+        (prompt.shape[0], prompt.shape[1] + max_new_tokens),
+        0 if pad_token is None else pad_token, np.int32,
+    )
+    for b, h in enumerate(handles):
+        toks = h.result(timeout=0)
+        out[b, : toks.shape[0]] = toks
+    return out

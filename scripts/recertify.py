@@ -16,6 +16,7 @@ Protocols (all via bench.py's existing modes — no new measurement code):
     lm_small @32k ... SEQ=32768 BATCH=1                tokens/sec
     lm_moe_small  BENCH_MODEL=lm_moe_small             tokens/sec
     decode        BENCH_DECODE=1 (b=8, 128+128)        tokens/sec
+    serve_lm      scripts/serve_bench.py (32k vocab)   tokens/sec
 
 Usage::
 
@@ -61,6 +62,17 @@ PROTOCOLS = {
         "BENCH_BATCH": "8",
     },
     "decode": {"BENCH_DECODE": "1", "BENCH_MODEL": "lm_small"},
+    # Serving tier: continuous batching vs sequential generate at 32k
+    # vocab under Poisson load (scripts/serve_bench.py — its own
+    # entrypoint, not a bench.py mode; the row's JSON line carries
+    # speedup, TTFT p50/p99, occupancy and the compile count, and the
+    # script exits non-zero on parity loss or a mid-measure recompile).
+    "serve_lm": {
+        "_script": "scripts/serve_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_REQUESTS": "32", "SERVE_MAX_NEW": "16",
+        "SERVE_RATE_RPS": "200", "SERVE_SLOTS": "8", "SERVE_BUCKETS": "8,16",
+    },
 }
 
 
@@ -72,6 +84,9 @@ PROTOCOLS = {
 _PROTOCOL_VARS = (
     "BENCH_MODEL", "BENCH_BATCH", "BENCH_SEQ_LEN", "BENCH_DECODE",
     "BENCH_DEPTH", "BENCH_IMAGE_SIZE", "BENCH_SCALING", "ACCUM_STEPS",
+    "BENCH_VOCAB", "SERVE_REQUESTS", "SERVE_MAX_NEW", "SERVE_RATE_RPS",
+    "SERVE_SLOTS", "SERVE_BUCKETS", "SERVE_QUEUE_DEPTH", "SERVE_SEED",
+    "SERVE_DEADLINE_MS", "SERVE_PREFILLS_PER_STEP", "SERVE_TOP_K_CAP",
 )
 
 
@@ -79,6 +94,8 @@ def run_protocol(name: str, env_over: dict, timeout_s: float) -> dict:
     env = dict(os.environ)
     for var in _PROTOCOL_VARS:
         env.pop(var, None)
+    env_over = dict(env_over)
+    script = env_over.pop("_script", "bench.py")
     env.update(env_over)
     # One persistent compilation cache across the whole battery (and
     # across re-runs at the same commit): every protocol subprocess
@@ -91,7 +108,7 @@ def run_protocol(name: str, env_over: dict, timeout_s: float) -> dict:
         t0 = time.perf_counter()
         try:
             r = subprocess.run(
-                [sys.executable, os.path.join(REPO, "bench.py")],
+                [sys.executable, os.path.join(REPO, script)],
                 env=env, timeout=timeout_s, capture_output=True, text=True,
             )
         except subprocess.TimeoutExpired:

@@ -1,0 +1,271 @@
+"""Continuous-batching serving benchmark — Poisson load vs sequential.
+
+The serving tier's certifiable protocol (BASELINE.md style, one JSON
+line on stdout): a seeded Poisson arrival stream of mixed-length
+requests is served twice —
+
+* **sequential baseline**: one request at a time through
+  ``inference.generate`` (each distinct shape warmed first, so the
+  comparison is pure steady-state throughput — the per-shape compiles
+  the slot engine avoids are reported separately, not smuggled into the
+  denominator);
+* **continuous batching**: the same requests submitted to
+  ``serving.Server`` on their arrival schedule, drained to completion.
+
+The record carries throughput (the headline ``value``), the sequential
+baseline and speedup, TTFT/queue-wait percentiles, mean slot occupancy
+and the engine's compile count — everything
+``scripts/recertify.py``'s ``serve_lm`` row needs to re-certify the
+protocol on hardware the moment the relay returns.
+
+Env knobs (defaults in parentheses): ``SERVE_SLOTS`` (8),
+``SERVE_BUCKETS`` ("8,16"), ``SERVE_REQUESTS`` (32),
+``SERVE_MAX_NEW`` (16), ``SERVE_RATE_RPS`` (200 — Poisson arrival
+rate; 0 = closed backlog, all at t=0), ``SERVE_SEED`` (0),
+``BENCH_MODEL`` (lm_tiny), ``BENCH_VOCAB`` (256), plus the generic
+``OBS_DIR``/``--events`` and ``COMPILATION_CACHE_DIR`` plumbing
+bench.py uses.
+
+Usage::
+
+    python scripts/serve_bench.py [--events]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(vals, q):
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def _emit_record(record: dict) -> None:
+    """bench.py's output contract: the canonical JSON line on stdout
+    plus the same record on the event bus."""
+    print(json.dumps(record), flush=True)
+    from distributeddeeplearning_tpu import obs
+
+    bus = obs.get_bus()
+    bus.point("bench_result", **record)
+    bus.flush()
+
+
+def build_requests(n, rate_rps, max_new, seed, vocab, prompt_lens):
+    """Seeded request set + Poisson arrival offsets (seconds). Mixed
+    prompt lengths, per-request sampling seeds — the adversarial mix
+    the parity oracle certifies, at load."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    reqs = []
+    t = 0.0
+    for i in range(n):
+        if rate_rps > 0:
+            t += float(rng.exponential(1.0 / rate_rps))
+        tp = int(prompt_lens[i % len(prompt_lens)])
+        reqs.append({
+            "arrival_s": t,
+            "prompt": rng.randint(0, vocab, size=(tp,)).astype(np.int32),
+            "max_new": max_new,
+            "seed": int(rng.randint(0, 2**31 - 1)),
+        })
+    return reqs
+
+
+def run_sequential(model, params, reqs, temperature, top_k):
+    """One-at-a-time baseline through inference.generate; each distinct
+    (prompt_len, max_new) shape is warmed first. Returns (tokens/sec,
+    per-request outputs, distinct compiled shapes)."""
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.inference import generate
+
+    shapes = sorted({(len(r["prompt"]), r["max_new"]) for r in reqs})
+    for tp, n_new in shapes:  # warm per-shape samplers out of the timing
+        generate(
+            model, params, np.zeros((1, tp), np.int32),
+            max_new_tokens=n_new, temperature=temperature, top_k=top_k,
+            rng=jax.random.PRNGKey(0),
+        )
+    outs = []
+    t0 = time.perf_counter()
+    for r in reqs:
+        out = generate(
+            model, params, r["prompt"][None], max_new_tokens=r["max_new"],
+            temperature=temperature, top_k=top_k,
+            rng=jax.random.PRNGKey(r["seed"]),
+        )
+        outs.append(np.asarray(out)[0])
+    dt = time.perf_counter() - t0
+    tokens = sum(r["max_new"] for r in reqs)
+    return tokens / dt, outs, len(shapes)
+
+
+def run_continuous(server, reqs, temperature, top_k):
+    """Replay the Poisson schedule against the serving loop: submit
+    each request at its arrival offset, pumping the scheduler while
+    waiting; drain. Returns (tokens/sec makespan throughput, handles,
+    wall seconds)."""
+    from distributeddeeplearning_tpu.serving import Request
+
+    handles = []
+    t0 = time.perf_counter()
+    for r in reqs:
+        while time.perf_counter() - t0 < r["arrival_s"]:
+            server.step()  # keep decoding while the next arrival is due
+        handles.append(server.submit(Request(
+            prompt=r["prompt"], max_new_tokens=r["max_new"],
+            temperature=temperature, top_k=top_k, rng=r["seed"],
+        )))
+    server.drain()
+    dt = time.perf_counter() - t0
+    tokens = sum(len(h.new_tokens) for h in handles)
+    return tokens / dt, handles, dt
+
+
+def main() -> int:
+    if "--events" in sys.argv[1:] or os.environ.get("OBS_DIR"):
+        from distributeddeeplearning_tpu import obs
+
+        if not os.environ.get("OBS_DIR"):
+            os.environ["OBS_DIR"] = os.path.join(
+                "runs", f"serve-bench-{int(time.time())}"
+            )
+        obs.configure_from_env()
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if os.environ.get("COMPILATION_CACHE_DIR"):
+        from distributeddeeplearning_tpu.training.warmup import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(os.environ["COMPILATION_CACHE_DIR"])
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.serving import (
+        Server, ServeConfig, SlotEngine,
+    )
+
+    env = os.environ
+    model_name = env.get("BENCH_MODEL", "lm_tiny")
+    # Realistic LM vocab by default: decode is weight/KV-bandwidth-bound
+    # (scripts/decode_audit.py), and the output projection over the full
+    # vocab is the term continuous batching amortises across slots —
+    # a toy vocab would benchmark dispatch overhead instead.
+    vocab = int(env.get("BENCH_VOCAB", "32000"))
+    n_requests = int(env.get("SERVE_REQUESTS", "32"))
+    max_new = int(env.get("SERVE_MAX_NEW", "16"))
+    rate_rps = float(env.get("SERVE_RATE_RPS", "200"))
+    seed = int(env.get("SERVE_SEED", "0"))
+    prompt_lens = (4, 7, 12, 5, 16, 3, 9, 14)
+    cfg = ServeConfig.from_env()
+    if cfg.buckets is None:
+        cfg.buckets = (8, 16)
+    max_len = max(prompt_lens) + max_new
+    temperature, top_k = 0.8, 40
+
+    try:
+        model = get_model(
+            model_name, num_classes=vocab, max_seq_len=max_len,
+            dtype=jnp.float32,
+        )
+        variables = jax.jit(model.init, static_argnames=("train",))(
+            jax.random.PRNGKey(0), jnp.zeros((2, max_len), jnp.int32),
+            train=False,
+        )
+        params = nn.unbox(variables["params"])
+        reqs = build_requests(
+            n_requests, rate_rps, max_new, seed, vocab, prompt_lens
+        )
+
+        seq_tps, seq_outs, seq_shapes = run_sequential(
+            model, params, reqs, temperature, top_k
+        )
+
+        engine = SlotEngine(
+            model, params, num_slots=cfg.num_slots, max_len=max_len,
+            buckets=cfg.buckets,
+        )
+        engine.warmup()
+        server = Server(
+            engine, queue_depth=max(cfg.queue_depth, n_requests),
+            prefills_per_step=cfg.prefills_per_step,
+        )
+        # Warm pass: one request end-to-end so first-dispatch overheads
+        # (host transfers, executable load) stay out of the measurement.
+        run_continuous(server, reqs[:1], temperature, top_k)
+        compile_count_pre = engine.compile_count
+
+        cont_tps, handles, wall_s = run_continuous(
+            server, reqs, temperature, top_k
+        )
+
+        # Per-request parity against the sequential outputs — the bench
+        # itself proves the speedup is not buying different tokens.
+        parity = all(
+            np.array_equal(h.tokens, seq_outs[i][: len(h.tokens)])
+            for i, h in enumerate(handles)
+        )
+        ttft_ms = [h.ttft_s * 1e3 for h in handles if h.ttft_s is not None]
+        qwait_ms = [
+            h.queue_wait_s * 1e3 for h in handles
+            if h.queue_wait_s is not None
+        ]
+        record = {
+            "metric": "serve_continuous_tokens_per_sec",
+            "value": round(cont_tps, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": round(cont_tps / seq_tps, 2) if seq_tps else 0.0,
+            "detail": {
+                "sequential_tokens_per_sec": round(seq_tps, 1),
+                "speedup_vs_sequential": round(cont_tps / seq_tps, 2)
+                if seq_tps else 0.0,
+                "parity": bool(parity),
+                "requests": n_requests,
+                "slots": cfg.num_slots,
+                "buckets": list(cfg.buckets),
+                "rate_rps": rate_rps,
+                "max_new_tokens": max_new,
+                "ttft_p50_ms": round(_percentile(ttft_ms, 0.5), 2),
+                "ttft_p99_ms": round(_percentile(ttft_ms, 0.99), 2),
+                "queue_wait_p50_ms": round(_percentile(qwait_ms, 0.5), 2),
+                "queue_wait_p99_ms": round(_percentile(qwait_ms, 0.99), 2),
+                "slot_occupancy_mean": round(server.occupancy_mean, 3),
+                "decode_steps": server.stats["decode_steps"],
+                "compile_count": engine.compile_count,
+                "compiles_during_measure": engine.compile_count
+                - compile_count_pre,
+                "sequential_compiled_shapes": seq_shapes,
+                "wall_s": round(wall_s, 2),
+                "platform": jax.devices()[0].platform,
+            },
+        }
+        _emit_record(record)
+        return 0 if parity and record["detail"]["compiles_during_measure"] == 0 else 1
+    except Exception as e:  # structured failure record, like bench.py
+        _emit_record({
+            "metric": "serve_continuous_tokens_per_sec", "value": 0.0,
+            "unit": "tokens/sec", "vs_baseline": 0.0, "error": repr(e),
+        })
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,42 @@ def test_recertify_run_protocol_tolerates_partial_json(monkeypatch):
     assert seen_env["COMPILATION_CACHE_DIR"] == ""
 
 
+def test_recertify_serve_row_dispatches_to_serve_bench(monkeypatch):
+    """The serve_lm protocol runs scripts/serve_bench.py (its own
+    entrypoint, not a bench.py mode) and ambient SERVE_* protocol vars
+    are scrubbed before the row's own env applies."""
+    import subprocess
+    import types
+
+    from scripts import recertify
+
+    seen = {}
+
+    def fake_run(cmd, env=None, timeout=None, capture_output=None, text=None):
+        seen["cmd"] = cmd
+        seen["env"] = dict(env or {})
+        return types.SimpleNamespace(
+            stdout='{"metric": "serve_continuous_tokens_per_sec", '
+                   '"value": 5.0}',
+            stderr="", returncode=0,
+        )
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setenv("SERVE_SLOTS", "99")  # ambient leak attempt
+    rec = recertify.run_protocol(
+        "serve_lm", recertify.PROTOCOLS["serve_lm"], 5.0
+    )
+    assert rec["value"] == 5.0
+    assert seen["cmd"][-1].endswith("scripts/serve_bench.py")
+    assert seen["env"]["SERVE_SLOTS"] == "8"  # the row's value, not 99
+    assert "_script" not in seen["env"]
+    assert recertify.PROTOCOLS["serve_lm"]["_script"]  # source not mutated
+
+    # every other row still runs bench.py
+    recertify.run_protocol("resnet50", {"BENCH_BATCH": "1"}, 5.0)
+    assert seen["cmd"][-1].endswith("bench.py")
+
+
 def test_device_init_watchdog():
     """A dead accelerator relay makes jax.devices() hang forever
     (observed: the tunnel went down and every jax call blocked). The

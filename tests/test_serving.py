@@ -1,0 +1,519 @@
+"""Continuous-batching serving oracles (serving/ + inference glue).
+
+The serving tier's whole value rests on two claims, both pinned here:
+
+1. **Parity** — a request co-decoded on the slot pool emits *bitwise*
+   the tokens sequential ``inference.generate`` emits for the same
+   (prompt, config, rng), whatever the co-scheduling: staggered joins,
+   mixed prompt lengths/buckets, neighbours hitting eos, mid-stream
+   cancellations freeing slots that are immediately re-admitted into.
+   Greedy and seeded sampling both.
+2. **Zero recompiles** — the engine's program set is closed at warmup
+   (``bucket_count + 1`` programs) and an admission/eviction churn
+   triggers no backend compile (counted via jax's
+   ``backend_compile_duration`` monitoring event, not inferred).
+
+Plus the host-side key schedule (``serving.keys`` — numpy threefry)
+pinned bitwise against this process's ``jax.random``, the per-slot
+sampler against ``inference._sample`` across the config matrix, and the
+scheduler lifecycle (backpressure, deadlines, cancel, drain).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.inference import _sample, generate
+from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+from distributeddeeplearning_tpu.serving import (
+    QueueFull,
+    ReqSpec,
+    Request,
+    ServeConfig,
+    Server,
+    SlotEngine,
+)
+from distributeddeeplearning_tpu.serving import keys as keylib
+from distributeddeeplearning_tpu.serving.sampling import sample_slot
+
+VOCAB, MAX_LEN = 64, 32
+BUCKETS = (4, 8, 16)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=MAX_LEN,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import flax.linen as nn
+
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, MAX_LEN), jnp.int32),
+        train=False,
+    )
+    return nn.unbox(variables["params"])
+
+
+@pytest.fixture(scope="module")
+def _engine(model, params):
+    eng = SlotEngine(
+        model, params, num_slots=4, max_len=MAX_LEN, buckets=BUCKETS
+    )
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def engine(_engine):
+    """The shared warmed engine, guaranteed empty per test."""
+    for s in _engine.active_slots:
+        _engine.release(s)
+    yield _engine
+    for s in _engine.active_slots:
+        _engine.release(s)
+
+
+def _prompt(rng, n):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _assert_request_parity(h, model, params):
+    """One handle's stream vs sequential generate at the same config.
+
+    Finished requests must match up to their own length (eos cuts the
+    stream; generate pads the remainder); cancelled/deadline-evicted
+    ones must still be an exact *prefix* — eviction can truncate a
+    stream but never corrupt it."""
+    r = h.request
+    rng = (
+        jax.random.PRNGKey(r.rng) if isinstance(r.rng, (int, np.integer))
+        else (None if r.rng is None else jnp.asarray(r.rng, jnp.uint32))
+    )
+    ref = np.asarray(generate(
+        model, params, np.asarray(r.prompt, np.int32)[None],
+        max_new_tokens=r.max_new_tokens, temperature=r.temperature,
+        top_k=r.top_k, top_p=r.top_p, eos_token=r.eos_token, rng=rng,
+    ))[0]
+    got = h.tokens
+    assert got.shape[0] <= ref.shape[0], (got.shape, ref.shape)
+    np.testing.assert_array_equal(got, ref[: got.shape[0]])
+    if h.finish_reason == "length":
+        assert len(h.new_tokens) == r.max_new_tokens
+    if h.finish_reason == "eos":
+        assert h.new_tokens[-1] == r.eos_token
+
+
+# -- host-side key schedule (serving.keys) -------------------------------
+
+
+def test_split_key_matches_jax():
+    for seed in (0, 1, 123456789, -7):
+        np.testing.assert_array_equal(
+            keylib.key_from_seed(seed), np.asarray(jax.random.PRNGKey(seed))
+        )
+    key = jax.random.PRNGKey(42)
+    for n in (1, 2, 3, 17, 64):
+        np.testing.assert_array_equal(
+            keylib.split_key(np.asarray(key), n),
+            np.asarray(jax.random.split(key, n)),
+        )
+
+
+def test_fold_key_matches_jax():
+    key = jax.random.PRNGKey(9)
+    for d in (0, 1, 5, 2**31 - 1):
+        np.testing.assert_array_equal(
+            keylib.fold_key(np.asarray(key), d),
+            np.asarray(jax.random.fold_in(key, d)),
+        )
+
+
+def test_request_key_ladder_matches_generate_schedule():
+    """Row 0 = first-token key, rows 1.. = the decode-loop split — the
+    exact derivation inside generate()'s compiled program."""
+    rng = jax.random.PRNGKey(5)
+    rng0, rng_loop = jax.random.split(rng)
+    for n in (1, 2, 9):
+        ladder = keylib.request_key_ladder(np.asarray(rng), n)
+        assert ladder.shape == (n, 2)
+        np.testing.assert_array_equal(ladder[0], np.asarray(rng0))
+        if n > 1:
+            np.testing.assert_array_equal(
+                ladder[1:], np.asarray(jax.random.split(rng_loop, n - 1))
+            )
+
+
+# -- per-slot sampler vs inference._sample -------------------------------
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k,top_p",
+    [
+        (0.0, None, None),   # greedy
+        (0.7, 5, None),      # sort-free top-k path
+        (0.7, VOCAB, None),  # top_k == vocab: keeps everything
+        (1.0, None, 0.9),    # nucleus alone (full-sort path)
+        (0.8, 8, 0.5),       # both filters compose
+        (1.3, None, None),   # plain temperature
+    ],
+)
+def test_sample_slot_matches_reference(temperature, top_k, top_p):
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(VOCAB).astype(np.float32) * 4)
+    for s in range(2):
+        key = jax.random.PRNGKey(s)
+        got = sample_slot(
+            logits, np.asarray(key),
+            jnp.float32(temperature),
+            jnp.int32(top_k or 0), jnp.float32(top_p or 0.0),
+        )
+        ref = _sample(logits[None], key, temperature, top_k, top_p)[0]
+        assert int(got) == int(ref), (temperature, top_k, top_p, s)
+
+
+# -- parity oracle: adversarial co-scheduling ----------------------------
+
+
+def test_parity_greedy_staggered_mixed_lengths(engine, model, params):
+    """8 greedy requests over 4 slots, mixed buckets, admitted one per
+    tick (staggered joins), different max_new — every stream bitwise."""
+    rng = np.random.RandomState(0)
+    server = Server(engine, prefills_per_step=1)
+    handles = [
+        server.submit(Request(
+            prompt=_prompt(rng, n), max_new_tokens=m,
+        ))
+        for n, m in [(3, 6), (7, 9), (12, 4), (16, 10),
+                     (4, 12), (9, 3), (14, 7), (5, 5)]
+    ]
+    server.drain()
+    assert all(h.status == "done" for h in handles)
+    for h in handles:
+        _assert_request_parity(h, model, params)
+
+
+def test_parity_sampled_churn_with_evictions(engine, model, params):
+    """Seeded-sampled requests under the nastiest co-scheduling we can
+    stage: staggered joins, a mid-stream cancellation freeing a slot
+    that is immediately re-admitted into, mixed greedy/sampled configs.
+    Every surviving stream bitwise; the victim's prefix bitwise too.
+    And the whole churn triggers ZERO backend compiles."""
+    from jax._src import monitoring
+
+    compiles = []
+    monitoring.register_event_duration_secs_listener(
+        lambda event, duration, **kw: compiles.append(event)
+        if "backend_compile" in event else None
+    )
+    baseline = len(compiles)
+
+    rng = np.random.RandomState(1)
+    server = Server(engine, prefills_per_step=2)
+    mk = lambda n, m, seed, **kw: server.submit(Request(  # noqa: E731
+        prompt=_prompt(rng, n), max_new_tokens=m, rng=seed, **kw
+    ))
+    wave1 = [
+        mk(3, 10, 11, temperature=0.9, top_k=8),
+        mk(8, 12, 12, temperature=0.7, top_k=5),
+        mk(13, 12, 13),  # greedy neighbour in the same pool
+        mk(16, 8, 14, temperature=1.1, top_k=40, top_p=0.9),
+    ]
+    for _ in range(4):
+        server.step()
+    victim = wave1[1]
+    victim.cancel()  # mid-stream eviction
+    wave2 = [
+        mk(5, 9, 21, temperature=0.8, top_k=6),   # lands in freed slot
+        mk(10, 6, 22, temperature=1.0, top_p=0.8),
+    ]
+    server.drain()
+    # Zero backend compiles across the whole churn — checked BEFORE the
+    # parity loop below, whose reference generate() calls legitimately
+    # compile new request shapes.
+    assert len(compiles) == baseline, compiles[baseline:]
+    assert victim.status == "cancelled"
+    assert 0 < len(victim.new_tokens) < victim.request.max_new_tokens
+    for h in wave1 + wave2:
+        _assert_request_parity(h, model, params)
+
+
+def test_parity_eos_freezes_and_frees_slot(engine, model, params):
+    """A request that hits eos mid-stream finishes early (stream ends at
+    the eos token) and its slot is reused; neighbours unaffected."""
+    rng = np.random.RandomState(2)
+    prompt = _prompt(rng, 5)
+    ref = np.asarray(generate(model, params, prompt[None],
+                              max_new_tokens=12))[0]
+    eos = int(ref[5 + 2])  # third greedy token → eos at step 3
+    server = Server(engine)
+    h_eos = server.submit(Request(
+        prompt=prompt, max_new_tokens=12, eos_token=eos,
+    ))
+    h_other = server.submit(Request(prompt=_prompt(rng, 9),
+                                    max_new_tokens=10))
+    server.drain()
+    assert h_eos.finish_reason == "eos"
+    gen = ref[5:]
+    first = int(np.argmax(gen == eos))
+    assert len(h_eos.new_tokens) == first + 1
+    _assert_request_parity(h_eos, model, params)
+    _assert_request_parity(h_other, model, params)
+    assert engine.occupancy == 0.0
+
+
+def test_generate_engine_routing_bitwise(engine, model, params):
+    """inference.generate(engine=...) — B=1 bitwise for greedy AND
+    seeded sampling; B>1 bitwise for greedy (keyless, so per-row
+    scheduling cannot matter)."""
+    rng = np.random.RandomState(4)
+    server = Server(engine)
+    p1 = rng.randint(0, VOCAB, size=(1, 6)).astype(np.int32)
+    for kw in (
+        dict(),
+        dict(temperature=0.8, top_k=7, rng=jax.random.PRNGKey(3)),
+        dict(temperature=1.0, top_p=0.85, rng=jax.random.PRNGKey(4)),
+    ):
+        ref = np.asarray(generate(model, params, p1, max_new_tokens=8, **kw))
+        got = np.asarray(generate(model, params, p1, max_new_tokens=8,
+                                  engine=server, **kw))
+        np.testing.assert_array_equal(got, ref)
+    pb = rng.randint(0, VOCAB, size=(3, 5)).astype(np.int32)
+    ref = np.asarray(generate(model, params, pb, max_new_tokens=6))
+    got = np.asarray(generate(model, params, pb, max_new_tokens=6,
+                              engine=server))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_generate_engine_eos_padding(engine, model, params):
+    """eos/pad semantics through the engine route match generate's:
+    finished rows freeze to pad_token, shapes stay [B, Tp+n]."""
+    rng = np.random.RandomState(6)
+    p1 = rng.randint(0, VOCAB, size=(1, 4)).astype(np.int32)
+    ref = np.asarray(generate(model, params, p1, max_new_tokens=10))
+    eos = int(ref[0, 4 + 1])
+    server = Server(engine)
+    want = np.asarray(generate(
+        model, params, p1, max_new_tokens=10, eos_token=eos, pad_token=0,
+    ))
+    got = np.asarray(generate(
+        model, params, p1, max_new_tokens=10, eos_token=eos, pad_token=0,
+        engine=server,
+    ))
+    assert got.shape == want.shape == (1, 14)
+    np.testing.assert_array_equal(got, want)
+    assert eos in got[0]  # eos actually fired; the tail froze to pad
+    np.testing.assert_array_equal(
+        got[0, 4 + int(np.argmax(got[0, 4:] == eos)) + 1:], 0
+    )
+
+
+# -- compiled-program budget ---------------------------------------------
+
+
+def test_compile_count_bound_and_warmup_idempotent(engine):
+    """The closed program set: exactly bucket_count + 1 executables, and
+    re-warmup adds none."""
+    assert engine.compile_count == len(BUCKETS) + 1
+    info = engine.warmup()
+    assert engine.compile_count == len(BUCKETS) + 1
+    assert info["programs"] == float(len(BUCKETS) + 1)
+
+
+def test_bucket_ladder():
+    from distributeddeeplearning_tpu.serving.engine import default_buckets
+
+    assert default_buckets(32) == (16, 32)
+    assert default_buckets(100) == (16, 32, 64, 100)
+    eng_buckets = BUCKETS
+    for plen, want in ((1, 4), (4, 4), (5, 8), (16, 16)):
+        b = [b for b in eng_buckets if plen <= b][0]
+        assert b == want
+
+
+def test_request_validation(engine):
+    with pytest.raises(ValueError, match="bucket"):
+        ReqSpec(np.zeros(17, np.int32), 2).validate(MAX_LEN, BUCKETS[-1])
+    with pytest.raises(ValueError, match="cache length"):
+        ReqSpec(np.zeros(16, np.int32), 17).validate(MAX_LEN, BUCKETS[-1])
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        ReqSpec(np.zeros(4, np.int32), 0).validate(MAX_LEN, BUCKETS[-1])
+    with pytest.raises(ValueError, match="top_p"):
+        ReqSpec(np.zeros(4, np.int32), 2, temperature=1.0,
+                top_p=0.0).validate(MAX_LEN, BUCKETS[-1])
+    engine.prefill(0, ReqSpec(np.zeros(3, np.int32), 2))
+    with pytest.raises(ValueError, match="occupied"):
+        engine.prefill(0, ReqSpec(np.zeros(3, np.int32), 2))
+
+
+def test_top_k_cap_enforced(model, params):
+    eng = SlotEngine(
+        model, params, num_slots=1, max_len=MAX_LEN, buckets=(8,),
+        top_k_cap=4,
+    )
+    eng.warmup()
+    with pytest.raises(ValueError, match="top_k_cap"):
+        eng.prefill(0, ReqSpec(
+            np.zeros(3, np.int32), 2, temperature=1.0, top_k=8,
+        ))
+    # with nucleus sampling in play the full-sort path serves any top_k
+    tok, _ = eng.prefill(0, ReqSpec(
+        np.zeros(3, np.int32), 2, temperature=1.0, top_k=8, top_p=0.9,
+        rng=3,
+    ))
+    assert 0 <= tok < VOCAB
+    # top_k >= vocab is "keep everything" — admitted on the capped path
+    eng.release(0)
+    eng.prefill(0, ReqSpec(
+        np.zeros(3, np.int32), 2, temperature=1.0, top_k=VOCAB + 10, rng=3,
+    ))
+    assert eng.compile_count == 2  # decode + one bucket, still closed
+    # ...and the cap rejects at SUBMIT time — the client's call site,
+    # never the serving loop's pump thread
+    eng.release(0)
+    with pytest.raises(ValueError, match="top_k_cap"):
+        Server(eng).submit(Request(
+            prompt=np.zeros(3, np.int32), max_new_tokens=2,
+            temperature=1.0, top_k=8,
+        ))
+
+
+# -- scheduler lifecycle -------------------------------------------------
+
+
+def test_queue_backpressure(engine):
+    server = Server(engine, queue_depth=2)
+    reqs = [Request(prompt=np.zeros(3, np.int32), max_new_tokens=2)
+            for _ in range(3)]
+    server.submit(reqs[0])
+    server.submit(reqs[1])
+    with pytest.raises(QueueFull):
+        server.submit(reqs[2])
+    assert server.stats["rejected"] == 1
+    server.drain()
+
+
+def test_deadline_evicts_queued_and_running(engine):
+    server = Server(engine)
+    # queued request whose deadline passes before admission
+    dead = server.submit(Request(
+        prompt=np.zeros(3, np.int32), max_new_tokens=4, deadline_ms=0.1,
+    ))
+    time.sleep(0.01)
+    server.step()
+    assert dead.status == "deadline" and dead.finish_reason == "deadline"
+    assert dead.new_tokens == []
+    # running request evicted mid-stream once its deadline expires
+    run = server.submit(Request(
+        prompt=np.zeros(4, np.int32), max_new_tokens=20, deadline_ms=40.0,
+    ))
+    server.step()  # admitted + first decode
+    assert run.status == "running"
+    time.sleep(0.06)
+    server.drain()
+    assert run.status == "deadline"
+    assert 0 < len(run.new_tokens) < 20
+    assert engine.occupancy == 0.0
+    assert server.stats["deadline"] == 2
+
+
+def test_cancel_queued_request(engine):
+    server = Server(engine)
+    h = server.submit(Request(prompt=np.zeros(3, np.int32),
+                              max_new_tokens=4))
+    h.cancel()
+    server.drain()
+    assert h.status == "cancelled" and h.new_tokens == []
+
+
+def test_result_blocks_and_close_rejects(engine):
+    server = Server(engine)
+    h = server.submit(Request(prompt=np.zeros(3, np.int32),
+                              max_new_tokens=3))
+    with pytest.raises(TimeoutError):
+        h.result(timeout=0)
+    server.close()
+    assert h.status == "done"
+    assert h.result(timeout=0).shape == (6,)
+    with pytest.raises(RuntimeError, match="closed"):
+        server.submit(Request(prompt=np.zeros(3, np.int32),
+                              max_new_tokens=3))
+
+
+def test_default_deadline_applied(engine):
+    server = Server(engine, default_deadline_ms=0.05)
+    h = server.submit(Request(prompt=np.zeros(3, np.int32),
+                              max_new_tokens=4))
+    assert h.request.deadline_ms == 0.05
+    time.sleep(0.01)
+    server.drain()
+    assert h.status == "deadline"
+
+
+def test_serve_config_from_env():
+    cfg = ServeConfig.from_env({
+        "SERVE_SLOTS": "16", "SERVE_BUCKETS": "8,32, 64",
+        "SERVE_QUEUE_DEPTH": "5", "SERVE_DEADLINE_MS": "1500",
+        "SERVE_PREFILLS_PER_STEP": "2", "SERVE_TOP_K_CAP": "256",
+    })
+    assert cfg.num_slots == 16
+    assert cfg.buckets == (8, 32, 64)
+    assert cfg.queue_depth == 5
+    assert cfg.deadline_ms == 1500.0
+    assert cfg.prefills_per_step == 2
+    assert cfg.top_k_cap == 256
+    dflt = ServeConfig.from_env({})
+    assert dflt.num_slots == 8 and dflt.buckets is None
+    assert dflt.deadline_ms is None
+
+
+def test_server_build_from_config(model, params):
+    server = Server.build(model, params, ServeConfig(
+        num_slots=2, buckets=(8,), queue_depth=3,
+    ))
+    assert server.engine.num_slots == 2
+    assert server.engine.buckets == (8,)
+    assert server.queue_depth == 3
+
+
+def test_obs_instrumentation(engine, tmp_path):
+    """The serving loop's spans/counters/gauges land on the bus and the
+    report's serving view renders them."""
+    from distributeddeeplearning_tpu import obs
+    from distributeddeeplearning_tpu.obs.report import (
+        load, render, summarize,
+    )
+
+    bus = obs.configure(str(tmp_path), run_id="serve-test", proc=0,
+                        install_handlers=False)
+    try:
+        server = Server(engine)
+        rng = np.random.RandomState(8)
+        hs = [server.submit(Request(prompt=_prompt(rng, n),
+                                    max_new_tokens=4))
+              for n in (3, 9)]
+        server.drain()
+        assert all(h.status == "done" for h in hs)
+        bus.flush()
+    finally:
+        obs.reset()
+    summary = summarize(load([str(tmp_path)]))
+    srv = summary["serving"]
+    assert srv is not None
+    assert srv["requests_done"] == 2
+    assert srv["admitted"] == 2 and srv["completed"] == 2
+    assert srv["tokens"] == 8
+    assert srv["occupancy_mean"] is not None
+    assert srv["ttft"]["count"] == 2
+    assert srv["queue_wait"]["count"] == 2
+    assert srv["decode_step"]["count"] >= 3
+    text = render(summary)
+    assert "serving (continuous batching)" in text
+    assert "ttft" in text
