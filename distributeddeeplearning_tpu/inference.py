@@ -45,12 +45,23 @@ from jax import lax
 PyTree = object
 
 
-def decode_variant(model):
+def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0):
     """The model re-staged for KV-cache decoding (shared contract of
     this module and ``serving.SlotEngine``): mutable-cache attention,
     plain XLA einsum (decode is bandwidth-bound; Pallas/ring paths are
-    training shapes), no sequence axis."""
-    return model.clone(decode=True, attn_impl="xla", seq_axis=None)
+    training shapes), no sequence axis.
+
+    ``paged_blocks > 0`` selects the paged cache layout (one
+    ``[paged_blocks, paged_block_size, H, Dh]`` pool per layer addressed
+    through per-row block tables — the serving engine's
+    ``kv_layout="paged"``); the sequential path here always decodes
+    dense, so the kwargs are only passed through when set (custom models
+    without the fields keep working)."""
+    kw = {}
+    if paged_blocks:
+        kw = dict(paged_blocks=int(paged_blocks),
+                  paged_block_size=int(paged_block_size))
+    return model.clone(decode=True, attn_impl="xla", seq_axis=None, **kw)
 
 
 def decode_cache_shapes(decode_model, batch: int, length: int):

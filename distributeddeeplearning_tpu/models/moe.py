@@ -205,6 +205,9 @@ class MoEDecoderBlock(nn.Module):
     dropout: float = 0.0
     seq_axis: Any = None
     decode: bool = False  # KV-cache inference (inference.generate)
+    # Paged KV cache (serving tier; see models/vit.Attention): 0 = dense.
+    paged_blocks: int = 0
+    paged_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -219,6 +222,8 @@ class MoEDecoderBlock(nn.Module):
             causal=True,
             seq_axis=self.seq_axis,
             decode=self.decode,
+            paged_blocks=self.paged_blocks,
+            paged_block_size=self.paged_block_size,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)

@@ -47,6 +47,9 @@ class DecoderBlock(nn.Module):
     dropout: float = 0.0
     seq_axis: Any = None
     decode: bool = False  # KV-cache inference (inference.generate)
+    # Paged KV cache (serving tier; see models/vit.Attention): 0 = dense.
+    paged_blocks: int = 0
+    paged_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -59,6 +62,8 @@ class DecoderBlock(nn.Module):
             causal=True,
             seq_axis=self.seq_axis,
             decode=self.decode,
+            paged_blocks=self.paged_blocks,
+            paged_block_size=self.paged_block_size,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -96,6 +101,12 @@ class TransformerLM(nn.Module):
     # with a full-length dummy to size the caches, then feed incremental
     # tokens with mutable=["cache"].
     decode: bool = False
+    # Paged KV cache (serving.SlotEngine kv_layout="paged"): the decode
+    # caches become one [paged_blocks, paged_block_size, H, Dh] pool per
+    # layer addressed through per-row block tables (models/vit.Attention
+    # ``_paged_decode_attention``). 0 = dense per-row cache.
+    paged_blocks: int = 0
+    paged_block_size: int = 0
     # Gradient checkpointing (rematerialization): recompute each block's
     # activations during backward instead of storing them — trades ~1
     # extra forward of FLOPs for O(depth) activation memory. REMAT=1.
@@ -200,6 +211,8 @@ class TransformerLM(nn.Module):
                     dropout=self.dropout,
                     seq_axis=self.seq_axis,
                     decode=self.decode,
+                    paged_blocks=self.paged_blocks,
+                    paged_block_size=self.paged_block_size,
                     name=f"block{i}",
                 )(x, train)
             else:
@@ -211,6 +224,8 @@ class TransformerLM(nn.Module):
                     self.dropout,
                     seq_axis=self.seq_axis,
                     decode=self.decode,
+                    paged_blocks=self.paged_blocks,
+                    paged_block_size=self.paged_block_size,
                     name=f"block{i}",
                 )(x, train)
 

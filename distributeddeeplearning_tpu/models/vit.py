@@ -137,6 +137,93 @@ class Attention(nn.Module):
     # cache buffers), then apply with the prompt / one token at a time
     # and mutable=["cache"] (driver: ``inference.generate``).
     decode: bool = False
+    # Paged KV cache (serving tier): ``paged_blocks > 0`` replaces the
+    # dense [B, max_len, H, Dh] cache rows with one shared pool of
+    # [paged_blocks, paged_block_size, H, Dh] per layer, addressed
+    # through a per-row int32 ``block_table`` cache leaf (logical block
+    # = position // block_size). Decode writes scatter through the
+    # table; attention gathers by it. Table entry 0 is the trash sink
+    # (``serving.blocks``) — padded-tail writes land there, position
+    # masks keep it unread. Requires per-row (vector) cache positions.
+    paged_blocks: int = 0
+    paged_block_size: int = 0
+
+    def _paged_decode_attention(self, q, k, v, ci):
+        """Block-table-indexed variant of the decode cache: same math
+        per row as the dense path at the same positions, but K/V live in
+        the shared block pool. Writes beyond the table's logical range
+        are routed to the trash block (clamped gather indices would
+        otherwise alias REAL tail blocks)."""
+        nb, bs = self.paged_blocks, self.paged_block_size
+        b, t = q.shape[0], q.shape[1]
+        heads, dh = k.shape[-2], k.shape[-1]
+        max_blocks = -(-k.shape[1] // bs) if self.is_initializing() else None
+        ck = self.variable(
+            "cache", "paged_k", jnp.zeros, (nb, bs, heads, dh), k.dtype
+        )
+        cv = self.variable(
+            "cache", "paged_v", jnp.zeros, (nb, bs, heads, dh), v.dtype
+        )
+        bt = self.variable(
+            "cache", "block_table",
+            lambda: jnp.zeros((b, max_blocks), jnp.int32),
+        )
+        if self.is_initializing():
+            return dot_product_attention(q, k, v, causal=self.causal)
+        idx = ci.value
+        if jnp.ndim(idx) == 0:
+            raise ValueError(
+                "paged decode requires per-row (vector) cache positions "
+                "— the serving engine's path; inference.generate stays "
+                "on the dense cache"
+            )
+        table = bt.value  # [B, max_blocks]
+        mb = table.shape[1]
+        pos = idx[:, None] + jnp.arange(t)  # [B, t] absolute positions
+        lb = pos // bs
+        # Out-of-range logical blocks (a bucket-padded prefill tail) go
+        # to the trash block; clamping alone would overwrite real rows.
+        pb = jnp.where(
+            lb < mb,
+            jnp.take_along_axis(table, jnp.clip(lb, 0, mb - 1), axis=1),
+            jnp.int32(0),
+        )
+        flat = (pb * bs + pos % bs).reshape(-1)  # [B*t] pool row ids
+        ck.value = (
+            ck.value.reshape(nb * bs, heads, dh)
+            .at[flat].set(k.reshape(-1, heads, dh))
+            .reshape(nb, bs, heads, dh)
+        )
+        cv.value = (
+            cv.value.reshape(nb * bs, heads, dh)
+            .at[flat].set(v.reshape(-1, heads, dh))
+            .reshape(nb, bs, heads, dh)
+        )
+        ci.value = idx + t
+        # Gather this row's logical view [B, mb*bs, H, Dh]; positions
+        # beyond the written depth are masked exactly like the dense
+        # path's unwritten tail (bitwise-invariant: masked scores are
+        # -inf -> exact zeros in the softmax/weighted sum).
+        k_all = jnp.take(ck.value, table, axis=0).reshape(b, mb * bs, heads, dh)
+        v_all = jnp.take(cv.value, table, axis=0).reshape(b, mb * bs, heads, dh)
+        return self._masked_decode_scores(q, k_all, v_all, pos)
+
+    def _masked_decode_scores(self, q, k_all, v_all, q_pos):
+        """Shared tail of both decode cache layouts: position-masked
+        attention of q ([B, t, H, Dh]) over the full static cache view."""
+        length = k_all.shape[1]
+        head_dim = q.shape[-1]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", (q * head_dim**-0.5), k_all
+        ).astype(jnp.float32)
+        k_pos = jnp.arange(length)
+        if q_pos.ndim == 1:
+            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,t,L]
+        else:
+            mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 
     def _decode_attention(self, q, k, v):
         """Single/few-token query against the growing KV cache. Static
@@ -151,11 +238,13 @@ class Attention(nn.Module):
         identical to the scalar path at that row's position."""
         from jax import lax
 
-        ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
-        cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
         ci = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
+        if self.paged_blocks:
+            return self._paged_decode_attention(q, k, v, ci)
+        ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
         if self.is_initializing():
             # init traces the full-length dummy: buffers get their final
             # [B, max_len, H, Dh] shape; run the normal path for tracing.
@@ -169,7 +258,6 @@ class Attention(nn.Module):
             # all cache slots <= that position (causal + written-so-far
             # in one)
             q_pos = idx + jnp.arange(t)  # [t]
-            mask = None  # built below against k_pos
         else:
             # Per-row positions: write row b's K/V at idx[b] (a vmapped
             # dynamic_update_slice lowers to a per-row scatter).
@@ -179,22 +267,9 @@ class Attention(nn.Module):
             ck.value = write(ck.value, k, idx)
             cv.value = write(cv.value, v, idx)
             q_pos = idx[:, None] + jnp.arange(t)  # [B, t]
-            mask = None
         ci.value = idx + t
         k_all, v_all = ck.value, cv.value
-        length = k_all.shape[1]
-        head_dim = q.shape[-1]
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", (q * head_dim**-0.5), k_all
-        ).astype(jnp.float32)
-        k_pos = jnp.arange(length)
-        if q_pos.ndim == 1:
-            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,t,L]
-        else:
-            mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
-        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+        return self._masked_decode_scores(q, k_all, v_all, q_pos)
 
     def _resolve_impl(self, x, head_dim: int) -> str:
         """``"auto"`` → the packed small-T kernel when the shape fits and

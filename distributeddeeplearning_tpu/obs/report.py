@@ -237,6 +237,15 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
             "cancelled": counters.get("serve.cancelled", 0),
             "tokens": counters.get("serve.tokens", 0),
             "occupancy_mean": occ[0] / occ[1] if occ and occ[1] else None,
+            # Paged KV pool (kv_layout="paged"): final free/total block
+            # gauges + cumulative prefix-cache hit blocks. All None/0 on
+            # the dense layout, which emits none of them.
+            "block_pool_free": gauges.get("serve.block_pool_free"),
+            "block_pool_total": gauges.get("serve.block_pool_total"),
+            "prefix_hits": gauges.get(
+                "serve.prefix_hits",
+                counters.get("serve.prefix_hit_blocks"),
+            ),
             "queue_wait": span_stats.get("serve.queue_wait"),
             "ttft": span_stats.get("serve.ttft"),
             "prefill": span_stats.get("serve.prefill"),
@@ -319,6 +328,15 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
         if srv["occupancy_mean"] is not None:
             add(f"  slot occupancy (mean over working ticks): "
                 f"{srv['occupancy_mean']:.2f}")
+        if srv.get("block_pool_total"):
+            total = srv["block_pool_total"]
+            free = srv.get("block_pool_free") or 0.0
+            util = 1.0 - free / total if total else 0.0
+            hits = srv.get("prefix_hits") or 0
+            add(
+                f"  block pool: {free:.0f}/{total:.0f} free at exit "
+                f"(final util {util:.2f}), prefix hits {hits:.0f} blocks"
+            )
         # Per-request latency anatomy: where the time went.
         for label, key in (
             ("queue wait", "queue_wait"), ("ttft", "ttft"),

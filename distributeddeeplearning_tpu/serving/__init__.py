@@ -23,6 +23,10 @@ Per-request output is **bitwise-identical** to sequential
 co-scheduling — ``tests/test_serving.py`` is the oracle.
 """
 
+from distributeddeeplearning_tpu.serving.blocks import (  # noqa: F401
+    BlockAllocator,
+    BlockPoolExhausted,
+)
 from distributeddeeplearning_tpu.serving.engine import (  # noqa: F401
     ReqSpec,
     SlotEngine,

@@ -43,6 +43,10 @@ from jax import lax
 
 from distributeddeeplearning_tpu import obs
 from distributeddeeplearning_tpu.serving import keys as keylib
+from distributeddeeplearning_tpu.serving.blocks import (
+    BlockAllocator,
+    BlockPoolExhausted,
+)
 from distributeddeeplearning_tpu.serving.sampling import (
     DEFAULT_TOP_K_CAP,
     sample_slot,
@@ -51,6 +55,11 @@ from distributeddeeplearning_tpu.serving.sampling import (
 from distributeddeeplearning_tpu.utils.logging import get_logger
 
 _INDEX_NAMES = ("cache_index", "pos_index")
+# Paged layout (kv_layout="paged"): the block pools are batch-independent
+# shared tensors; the block table is per-row routing data fed each step
+# exactly like the position vectors.
+_PAGED_POOL_NAMES = ("paged_k", "paged_v")
+_TABLE_NAME = "block_table"
 
 
 def default_buckets(max_len: int, smallest: int = 16) -> Tuple[int, ...]:
@@ -128,9 +137,17 @@ class SlotEngine:
         max_len: Optional[int] = None,
         buckets: Optional[Tuple[int, ...]] = None,
         top_k_cap: int = DEFAULT_TOP_K_CAP,
+        kv_layout: str = "dense",
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prefix_cache: bool = True,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
+            )
         model_max = getattr(model, "max_seq_len", None)
         if max_len is None:
             if model_max is None:
@@ -144,9 +161,32 @@ class SlotEngine:
         from distributeddeeplearning_tpu.inference import decode_variant
 
         self.model = model
-        self.decode_model = decode_variant(model)
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
+        self.kv_layout = kv_layout
+        self.allocator: Optional[BlockAllocator] = None
+        self.prefix_cache = bool(prefix_cache) and kv_layout == "paged"
+        if kv_layout == "paged":
+            if block_size < 1:
+                raise ValueError(f"block_size must be >= 1, got {block_size}")
+            self.block_size = int(block_size)
+            self.blocks_per_slot = -(-self.max_len // self.block_size)
+            if num_blocks is None:
+                # Dense-equivalent KV bytes by default (+ the trash
+                # block): paging then wins by ADMITTING more, not by
+                # shrinking the pool.
+                num_blocks = self.num_slots * self.blocks_per_slot + 1
+            self.num_blocks = int(num_blocks)
+            self.allocator = BlockAllocator(self.num_blocks, self.block_size)
+            self.decode_model = decode_variant(
+                model, paged_blocks=self.num_blocks,
+                paged_block_size=self.block_size,
+            )
+        else:
+            self.block_size = 0
+            self.blocks_per_slot = 0
+            self.num_blocks = 0
+            self.decode_model = decode_variant(model)
         bs = tuple(sorted(set(int(b) for b in (buckets or default_buckets(max_len)))))
         if not bs or bs[0] < 1:
             raise ValueError(f"invalid bucket ladder {bs}")
@@ -197,6 +237,16 @@ class SlotEngine:
         self._eos = np.full(s, -1, np.int32)
         self._ladders: List[Optional[np.ndarray]] = [None] * s
         self._cursor = np.zeros(s, np.int64)
+        # Paged bookkeeping: per-slot block table (unused entries point
+        # at the trash block 0) and the owned block-id lists.
+        self._tables = (
+            np.zeros((s, self.blocks_per_slot), np.int32)
+            if kv_layout == "paged" else None
+        )
+        self._slot_blocks: List[List[int]] = [[] for _ in range(s)]
+        # Introspection for the prefix-sharing oracle: what the most
+        # recent prefill actually did (bucket, start, shared blocks).
+        self.last_prefill: Optional[Dict[str, Any]] = None
 
         self._pool = None
         self._decode_exec = None
@@ -215,12 +265,21 @@ class SlotEngine:
             for path, leaf in self._template.items()
         })
 
-    def _with_positions(self, cache, positions):
+    def _with_positions(self, cache, positions, tables=None):
+        """Feed the per-step routing data: position vectors into every
+        index leaf and (paged layout) the block table into every
+        ``block_table`` leaf. The device copies of both are never
+        authoritative — the host re-feeds them each call."""
         flat = self._flatten(self._unfreeze(cache))
-        return self._unflatten({
-            path: (positions if path[-1] in _INDEX_NAMES else leaf)
-            for path, leaf in flat.items()
-        })
+        out = {}
+        for path, leaf in flat.items():
+            if path[-1] in _INDEX_NAMES:
+                out[path] = positions
+            elif tables is not None and path[-1] == _TABLE_NAME:
+                out[path] = tables
+            else:
+                out[path] = leaf
+        return self._unflatten(out)
 
     # -- traced programs ---------------------------------------------------
 
@@ -277,6 +336,64 @@ class SlotEngine:
         }
         return self._unflatten(out), first, eos_hit
 
+    def _decode_paged_fn(
+        self, params, cache, tokens, positions, tables, step_keys, temps,
+        top_ks, top_ps, eos,
+    ):
+        """Paged twin of :meth:`_decode_fn`: identical math per slot —
+        only the KV residency differs (block pool + table routing)."""
+        cache = self._with_positions(cache, positions, tables)
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, None],
+            train=False,
+            mutable=["cache"],
+        )
+        nxt = sample_slots(
+            logits[:, -1], step_keys, temps, top_ks, top_ps,
+            top_k_cap=self.top_k_cap,
+        )
+        eos_hit = (nxt == eos) & (eos >= 0)
+        return self._unfreeze(mutated["cache"]), nxt, eos_hit
+
+    def _prefill_paged_fn(
+        self, params, pool, table_row, start, tokens, last_idx, key, temp,
+        top_k, top_p, eos,
+    ):
+        """Paged prefill: run the (suffix of the) prompt at absolute
+        positions ``[start, start + bucket)`` THROUGH the pool — K/V
+        writes scatter into the slot's table-mapped blocks, attention
+        gathers any already-shared prefix blocks, and the first token is
+        sampled at ``last_idx`` (the true last prompt position relative
+        to ``start``). With ``start == 0`` this is a plain full-prompt
+        prefill; with a prefix-cache hit it computes ONLY the divergent
+        suffix — the shared blocks are never recomputed or rewritten
+        (writes begin at the block-aligned ``start``). One program per
+        bucket either way: start/table/last_idx are data, so the program
+        set stays closed at ``len(buckets) + 1``."""
+        cache = self._with_positions(pool, start, table_row)
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            train=False,
+            mutable=["cache"],
+        )
+        last = lax.dynamic_index_in_dim(
+            logits[0], last_idx, axis=0, keepdims=False
+        )
+        first = sample_slot(last, key, temp, top_k, top_p, self.top_k_cap)
+        eos_hit = (first == eos) & (eos >= 0)
+        mflat = self._flatten(self._unfreeze(mutated["cache"]))
+        pflat = self._flatten(self._unfreeze(pool))
+        # Only the shared block pools were meaningfully mutated; the
+        # [1]-batch table/index leaves are re-fed by the host anyway, so
+        # the pool passes its own [num_slots]-shaped copies through.
+        out = {
+            path: (mflat[path] if path[-1] in _PAGED_POOL_NAMES else leaf)
+            for path, leaf in pflat.items()
+        }
+        return self._unflatten(out), first, eos_hit
+
     # -- compilation -------------------------------------------------------
 
     def warmup(self) -> Dict[str, float]:
@@ -289,34 +406,53 @@ class SlotEngine:
             # Canonical pool layout: index leaves are [num_slots]
             # vectors (the decode step's per-slot positions) so every
             # program — prefill passes them through, decode rewrites
-            # them — sees one stable signature. Each leaf gets its OWN
+            # them — sees one stable signature; everything else keeps
+            # its template shape (dense K/V rows batched over slots; in
+            # the paged layout the block pools are batch-independent
+            # shared tensors and the block table is [num_slots,
+            # blocks_per_slot] routing data). Each leaf gets its OWN
             # buffer: the pool is donated, and donating one aliased
             # buffer through several leaves is an XLA error.
             self._pool = jax.device_put(self._unflatten({
                 path: jnp.zeros(
-                    (self.num_slots,) + (
-                        leaf.shape[1:] if path[-1] not in _INDEX_NAMES
-                        else ()
-                    ),
+                    (self.num_slots,) if path[-1] in _INDEX_NAMES
+                    else leaf.shape,
                     jnp.int32 if path[-1] in _INDEX_NAMES else leaf.dtype,
                 )
                 for path, leaf in self._template.items()
             }))
         s = self.num_slots
+        paged = self.kv_layout == "paged"
         if self._decode_exec is None:
             with obs.span("compile", what="serve_decode", slots=s):
                 t0 = time.perf_counter()
-                self._decode_exec = (
-                    jax.jit(self._decode_fn, donate_argnums=(1,))
-                    .lower(
-                        self.params, self._pool,
-                        np.zeros(s, np.int32), np.zeros(s, np.int32),
-                        np.zeros((s, 2), np.uint32), np.zeros(s, np.float32),
-                        np.zeros(s, np.int32), np.zeros(s, np.float32),
-                        np.full(s, -1, np.int32),
+                if paged:
+                    self._decode_exec = (
+                        jax.jit(self._decode_paged_fn, donate_argnums=(1,))
+                        .lower(
+                            self.params, self._pool,
+                            np.zeros(s, np.int32), np.zeros(s, np.int32),
+                            np.zeros((s, self.blocks_per_slot), np.int32),
+                            np.zeros((s, 2), np.uint32),
+                            np.zeros(s, np.float32), np.zeros(s, np.int32),
+                            np.zeros(s, np.float32),
+                            np.full(s, -1, np.int32),
+                        )
+                        .compile()
                     )
-                    .compile()
-                )
+                else:
+                    self._decode_exec = (
+                        jax.jit(self._decode_fn, donate_argnums=(1,))
+                        .lower(
+                            self.params, self._pool,
+                            np.zeros(s, np.int32), np.zeros(s, np.int32),
+                            np.zeros((s, 2), np.uint32),
+                            np.zeros(s, np.float32),
+                            np.zeros(s, np.int32), np.zeros(s, np.float32),
+                            np.full(s, -1, np.int32),
+                        )
+                        .compile()
+                    )
                 self.compile_sec += time.perf_counter() - t0
             self.compile_count += 1
         for bucket in self.buckets:
@@ -324,19 +460,36 @@ class SlotEngine:
                 continue
             with obs.span("compile", what=f"serve_prefill_b{bucket}"):
                 t0 = time.perf_counter()
-                self._prefill_exec[bucket] = (
-                    jax.jit(self._prefill_fn, donate_argnums=(1,))
-                    .lower(
-                        self.params, self._pool,
-                        np.int32(0), np.zeros((1, bucket), np.int32),
-                        np.int32(1), np.zeros(2, np.uint32),
-                        np.float32(0), np.int32(0), np.float32(0),
-                        np.int32(-1),
+                if paged:
+                    self._prefill_exec[bucket] = (
+                        jax.jit(self._prefill_paged_fn, donate_argnums=(1,))
+                        .lower(
+                            self.params, self._pool,
+                            np.zeros((1, self.blocks_per_slot), np.int32),
+                            np.zeros(1, np.int32),
+                            np.zeros((1, bucket), np.int32),
+                            np.int32(0), np.zeros(2, np.uint32),
+                            np.float32(0), np.int32(0), np.float32(0),
+                            np.int32(-1),
+                        )
+                        .compile()
                     )
-                    .compile()
-                )
+                else:
+                    self._prefill_exec[bucket] = (
+                        jax.jit(self._prefill_fn, donate_argnums=(1,))
+                        .lower(
+                            self.params, self._pool,
+                            np.int32(0), np.zeros((1, bucket), np.int32),
+                            np.int32(1), np.zeros(2, np.uint32),
+                            np.float32(0), np.int32(0), np.float32(0),
+                            np.int32(-1),
+                        )
+                        .compile()
+                    )
                 self.compile_sec += time.perf_counter() - t0
             self.compile_count += 1
+        if paged:
+            self._emit_pool_gauges()
         info = {
             "compile_sec": self.compile_sec,
             "programs": float(self.compile_count),
@@ -351,6 +504,40 @@ class SlotEngine:
         return info
 
     # -- slot lifecycle ----------------------------------------------------
+
+    def _emit_pool_gauges(self) -> None:
+        a = self.allocator
+        obs.gauge("serve.block_pool_total", float(a.capacity))
+        obs.gauge("serve.block_pool_free", float(a.free_count))
+        obs.gauge("serve.prefix_hits", float(a.stats["prefix_hit_blocks"]))
+
+    def pool_stats(self) -> Optional[Dict[str, int]]:
+        """Block-pool gauges (None on the dense layout)."""
+        return None if self.allocator is None else self.allocator.snapshot()
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Physical blocks a request writes: positions 0 ..
+        prompt_len + max_new_tokens - 2 (the final sampled token is
+        never fed back, so its K/V is never written)."""
+        return self.allocator.blocks_for_tokens(
+            prompt_len + max_new_tokens - 1
+        )
+
+    def can_admit(self, spec: "ReqSpec") -> bool:
+        """Admission gate beyond slot availability: on the paged layout
+        a request needs its (prefix-discounted) block count free. The
+        scheduler checks this before committing a queue pop — block
+        exhaustion is backpressure, not an error."""
+        if self.allocator is None:
+            return True
+        prompt = np.asarray(spec.prompt, np.int32).reshape(-1)
+        t = prompt.shape[0]
+        hit = (
+            self.allocator.peek_prefix(prompt, t - 1)
+            if self.prefix_cache else 0
+        )
+        need = self.blocks_needed(t, spec.max_new_tokens) - hit
+        return self.allocator.free_count >= max(need, 0)
 
     @property
     def free_slots(self) -> List[int]:
@@ -380,6 +567,15 @@ class SlotEngine:
         Returns the effective top_k (``top_k >= vocab`` maps to 0 =
         filter off, the reference's clamp — same draw)."""
         spec.validate(self.max_len, self.buckets[-1])
+        if self.allocator is not None:
+            t = int(np.asarray(spec.prompt).shape[-1])
+            worst = self.blocks_needed(t, spec.max_new_tokens)
+            if worst > self.allocator.capacity:
+                raise ValueError(
+                    f"request needs {worst} KV blocks but the pool holds "
+                    f"{self.allocator.capacity}; raise SERVE_NUM_BLOCKS / "
+                    "SlotEngine(num_blocks=...)"
+                )
         tk = int(spec.top_k or 0)
         vocab = getattr(self.model, "vocab_size", None)
         if tk and vocab is not None and tk >= int(vocab):
@@ -406,9 +602,6 @@ class SlotEngine:
             self.warmup()
         prompt = np.asarray(spec.prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
-        bucket = self.bucket_for(t)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :t] = prompt
         sampled = spec.temperature > 0.0
         ladder = (
             keylib.request_key_ladder(spec.key_data(), spec.max_new_tokens)
@@ -420,10 +613,23 @@ class SlotEngine:
         top_k = np.int32(tk)
         top_p = np.float32(spec.top_p or 0.0)
         eos = np.int32(-1 if spec.eos_token is None else spec.eos_token)
-        self._pool, first, eos_hit = self._prefill_exec[bucket](
-            self.params, self._pool, np.int32(slot), padded, np.int32(t),
-            np.asarray(key0, np.uint32), temp, top_k, top_p, eos,
-        )
+        if self.allocator is not None:
+            first, eos_hit = self._prefill_paged(
+                slot, spec, prompt, key0, temp, top_k, top_p, eos
+            )
+        else:
+            bucket = self.bucket_for(t)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t] = prompt
+            self._pool, first, eos_hit = self._prefill_exec[bucket](
+                self.params, self._pool, np.int32(slot), padded,
+                np.int32(t), np.asarray(key0, np.uint32), temp, top_k,
+                top_p, eos,
+            )
+            self.last_prefill = {
+                "slot": slot, "bucket": bucket, "start": 0,
+                "shared_blocks": 0,
+            }
         self._active[slot] = True
         self._tokens[slot] = int(first)
         self._positions[slot] = t
@@ -434,6 +640,58 @@ class SlotEngine:
         self._ladders[slot] = ladder
         self._cursor[slot] = 1
         return int(first), bool(eos_hit)
+
+    def _prefill_paged(
+        self, slot, spec, prompt, key0, temp, top_k, top_p, eos
+    ) -> Tuple[Any, Any]:
+        """Paged admission: match the prompt's block-aligned prefix
+        against the prefix cache, allocate the remaining blocks
+        (all-or-nothing; :class:`BlockPoolExhausted` propagates as
+        backpressure), and prefill ONLY the divergent suffix through the
+        slot's block table. The match is capped at ``prompt_len - 1``
+        tokens so at least the last prompt position is always computed —
+        the first token's logits come from this program."""
+        a = self.allocator
+        t = prompt.shape[0]
+        shared: List[int] = (
+            a.match_prefix(prompt, t - 1) if self.prefix_cache else []
+        )
+        start = len(shared) * self.block_size
+        suffix = prompt[start:]
+        suffix_len = t - start
+        bucket = self.bucket_for(suffix_len)
+        need_new = self.blocks_needed(t, spec.max_new_tokens) - len(shared)
+        try:
+            fresh = a.alloc(max(need_new, 0))
+        except BlockPoolExhausted:
+            a.release_match(shared)
+            raise
+        blocks = shared + fresh
+        table_row = np.zeros((1, self.blocks_per_slot), np.int32)
+        table_row[0, :len(blocks)] = blocks
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :suffix_len] = suffix
+        self._pool, first, eos_hit = self._prefill_exec[bucket](
+            self.params, self._pool, table_row,
+            np.asarray([start], np.int32), padded,
+            np.int32(suffix_len - 1), np.asarray(key0, np.uint32), temp,
+            top_k, top_p, eos,
+        )
+        if self.prefix_cache:
+            # The full prompt blocks this request owns are now written
+            # and immutable (decode writes start at prompt_len) — make
+            # them discoverable. Already-shared blocks are skipped.
+            a.register_prefix(prompt, blocks)
+        self._tables[slot] = table_row[0]
+        self._slot_blocks[slot] = blocks
+        self.last_prefill = {
+            "slot": slot, "bucket": bucket, "start": start,
+            "shared_blocks": len(shared), "blocks": list(blocks),
+        }
+        if len(shared):
+            obs.counter("serve.prefix_hit_blocks", len(shared))
+        self._emit_pool_gauges()
+        return first, eos_hit
 
     def decode_step(self) -> List[Tuple[int, int, bool]]:
         """One batched decode tick: every occupied slot emits its next
@@ -447,10 +705,18 @@ class SlotEngine:
             ladder = self._ladders[i]
             if ladder is not None:
                 step_keys[i] = ladder[min(self._cursor[i], len(ladder) - 1)]
-        self._pool, nxt, eos_hit = self._decode_exec(
-            self.params, self._pool, self._tokens, self._positions,
-            step_keys, self._temps, self._top_ks, self._top_ps, self._eos,
-        )
+        if self.allocator is not None:
+            self._pool, nxt, eos_hit = self._decode_exec(
+                self.params, self._pool, self._tokens, self._positions,
+                self._tables, step_keys, self._temps, self._top_ks,
+                self._top_ps, self._eos,
+            )
+        else:
+            self._pool, nxt, eos_hit = self._decode_exec(
+                self.params, self._pool, self._tokens, self._positions,
+                step_keys, self._temps, self._top_ks, self._top_ps,
+                self._eos,
+            )
         nxt = np.array(nxt)
         eos_hit = np.array(eos_hit)
         self.decode_steps += 1
@@ -465,7 +731,10 @@ class SlotEngine:
     def release(self, slot: int) -> None:
         """Free a slot (eviction). Pure host bookkeeping — the stale
         cache rows are unreachable (per-slot position masks) and fully
-        overwritten by the next prefill into this slot."""
+        overwritten by the next prefill into this slot. On the paged
+        layout the slot's blocks are dereferenced (prefix-cached blocks
+        stay resident and evictable; private ones return to the free
+        list) and its table row re-points at the trash block."""
         self._active[slot] = False
         self._ladders[slot] = None
         self._tokens[slot] = 0
@@ -475,3 +744,9 @@ class SlotEngine:
         self._top_ps[slot] = 0.0
         self._eos[slot] = -1
         self._cursor[slot] = 0
+        if self.allocator is not None:
+            for bid in self._slot_blocks[slot]:
+                self.allocator.decref(bid)
+            self._slot_blocks[slot] = []
+            self._tables[slot] = 0
+            self._emit_pool_gauges()

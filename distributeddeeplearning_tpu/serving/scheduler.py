@@ -54,6 +54,14 @@ class ServeConfig:
     deadline_ms: Optional[float] = None
     prefills_per_step: int = 1
     top_k_cap: int = 128
+    # Paged KV pool (docs/SERVING.md): "dense" keeps one max_len row per
+    # slot; "paged" switches to the block pool + per-slot block tables.
+    kv_layout: str = "dense"
+    block_size: int = 16
+    # 0 = auto: dense-equivalent bytes (num_slots * ceil(max_len /
+    # block_size) + the trash block).
+    num_blocks: int = 0
+    prefix_cache: bool = True
 
     @classmethod
     def from_env(cls, env=None) -> "ServeConfig":
@@ -73,7 +81,26 @@ class ServeConfig:
                 e.get("SERVE_PREFILLS_PER_STEP", cls.prefills_per_step)
             ),
             top_k_cap=int(e.get("SERVE_TOP_K_CAP", cls.top_k_cap)),
+            kv_layout=str(e.get("SERVE_KV_LAYOUT", cls.kv_layout)),
+            block_size=int(e.get("SERVE_BLOCK_SIZE", cls.block_size)),
+            num_blocks=int(e.get("SERVE_NUM_BLOCKS", cls.num_blocks)),
+            prefix_cache=str(
+                e.get("SERVE_PREFIX_CACHE", "1" if cls.prefix_cache else "0")
+            ) not in ("0", "false", "off"),
         )
+
+    def engine_kwargs(self) -> dict:
+        kw = dict(
+            num_slots=self.num_slots, buckets=self.buckets,
+            top_k_cap=self.top_k_cap, kv_layout=self.kv_layout,
+        )
+        if self.kv_layout == "paged":
+            kw.update(
+                block_size=self.block_size,
+                num_blocks=self.num_blocks or None,
+                prefix_cache=self.prefix_cache,
+            )
+        return kw
 
 
 @dataclasses.dataclass
@@ -184,6 +211,9 @@ class Server:
             "admitted": 0, "completed": 0, "rejected": 0, "cancelled": 0,
             "deadline": 0, "tokens": 0, "decode_steps": 0,
             "occupancy_sum": 0.0, "occupancy_samples": 0,
+            # Peak co-resident requests — the capacity headline the
+            # paged-vs-dense bench compares at a fixed pool-byte budget.
+            "peak_active": 0,
         }
 
     @classmethod
@@ -193,8 +223,7 @@ class Server:
         default)."""
         cfg = config or ServeConfig.from_env()
         engine = SlotEngine(
-            model, params, num_slots=cfg.num_slots, buckets=cfg.buckets,
-            top_k_cap=cfg.top_k_cap, **engine_kw,
+            model, params, **cfg.engine_kwargs(), **engine_kw,
         )
         return cls(
             engine,
@@ -291,6 +320,16 @@ class Server:
                 if not self._queue:
                     return
                 handle = self._queue.popleft()
+            # Block-pool gate (paged layout): FIFO order is preserved —
+            # a head request that doesn't fit waits at the front until
+            # running streams release blocks. A backed-up queue then
+            # surfaces as QueueFull at submit (backpressure), exactly
+            # like slot exhaustion.
+            if not self.engine.can_admit(handle.request.spec()):
+                with self._lock:
+                    self._queue.appendleft(handle)
+                return
+            with self._lock:
                 obs.gauge("serve.queue_depth", float(len(self._queue)))
             slot = free[0]
             handle.queue_wait_s = now - handle.submitted_t
@@ -327,6 +366,9 @@ class Server:
         now = time.monotonic()
         self._reap(now)
         self._admit(now)
+        self.stats["peak_active"] = max(
+            self.stats["peak_active"], len(self._by_slot)
+        )
         if self._by_slot:
             with obs.span("serve.decode_step", active=len(self._by_slot)):
                 emitted = self.engine.decode_step()

@@ -19,10 +19,21 @@ Batch amortizes the parameter (and, less obviously, nothing else: the KV
 cache scales WITH batch, so at large b the cache term dominates and
 tok/s/seq degrades). The sweep shows exactly where that crossover sits.
 
+**Paged mode** (``--kv-layout paged``, the serving tier's
+``SERVE_KV_LAYOUT=paged`` — docs/SERVING.md): decode runs through the
+block-pool ``SlotEngine`` instead of ``inference.generate``, and the
+floor accounts what that path actually streams per step: the
+table-gathered K/V view (``blocks_per_slot * block_size`` rows per
+sequence — block-rounded, so ≥ the dense ``max_len``) PLUS the per-slot
+int32 block tables the gather indexes through. Leaving the table bytes
+out would overstate ``pct_of_floor`` in paged mode; they are itemized as
+``block_table_bytes`` in each row.
+
 Usage::
 
     python scripts/decode_audit.py [--model lm_small] [--prompt-len 128]
         [--new-tokens 128] [--batches 1,2,4,8,16,32,64]
+        [--kv-layout dense|paged] [--block-size 16]
         [--profile-dir /tmp/decode_trace]
 
 Prints a per-batch table and ONE summary JSON line.
@@ -56,11 +67,13 @@ def tree_bytes(tree) -> int:
 
 
 def sweep_row(b: int, tps: float, kv_bytes: int, bytes_per_step: int,
-              floor: float, on_tpu: bool) -> dict:
+              floor: float, on_tpu: bool, table_bytes: int = 0) -> dict:
     """One sweep record. VERDICT r5 item 8: the byte floor is a v5e HBM
     roofline — off-chip (CPU smoke) it is NOT a position, so
     ``pct_of_floor`` is emitted as None there and the analytic floor is
-    kept under an explicitly-labelled key instead."""
+    kept under an explicitly-labelled key instead. ``table_bytes`` (paged
+    mode) is already inside ``bytes_per_step``; it is itemized so the
+    floor's paged overhead stays auditable."""
     row = {
         "batch": b,
         "tokens_per_sec": round(tps, 1),
@@ -70,6 +83,8 @@ def sweep_row(b: int, tps: float, kv_bytes: int, bytes_per_step: int,
         "analytic_floor_tokens_per_sec": round(floor, 1),
         "pct_of_floor": round(100.0 * tps / floor, 1) if on_tpu else None,
     }
+    if table_bytes:
+        row["block_table_bytes"] = int(table_bytes)
     return row
 
 
@@ -82,8 +97,86 @@ def format_row(row: dict) -> str:
             f"{pct_str} {row['kv_cache_mb']:>10.1f}")
 
 
+def paged_step_bytes(model, b: int, max_len: int, block_size: int):
+    """Per-decode-step streamed KV bytes of the PAGED layout for ``b``
+    co-resident sequences: the table-gathered K/V view (each sequence
+    reads its ``blocks_per_slot`` blocks — block-rounded ``max_len``)
+    plus the int32 block tables the gather routes through. Shape-only
+    (``eval_shape`` of the paged decode clone's init — exactly how the
+    serving engine sizes its pool)."""
+    import jax
+    import jax.numpy as jnp
+    from flax import traverse_util
+
+    from distributeddeeplearning_tpu.inference import decode_variant
+
+    mb = -(-max_len // block_size)
+    paged_model = decode_variant(
+        model, paged_blocks=b * mb + 1, paged_block_size=block_size
+    )
+    shapes = jax.eval_shape(
+        lambda r: paged_model.init(
+            r, jnp.zeros((b, max_len), jnp.int32), train=False
+        ),
+        jax.random.PRNGKey(0),
+    )["cache"]
+    view_bytes = table_bytes = 0
+    for path, leaf in traverse_util.flatten_dict(dict(shapes)).items():
+        if path[-1] == "block_table":
+            table_bytes += math.prod(leaf.shape) * 4
+        elif path[-1] in ("paged_k", "paged_v"):
+            _, bs, heads, dh = leaf.shape
+            view_bytes += (
+                b * mb * bs * heads * dh * np.dtype(leaf.dtype).itemsize
+            )
+    return view_bytes, table_bytes
+
+
+def measure_paged(model, params, b: int, prompt_len: int, new_tokens: int,
+                  block_size: int, vocab: int, reps: int = 3) -> float:
+    """Measured paged-decode throughput: ``b`` requests co-resident in a
+    block-pool SlotEngine, timing the batched decode steps (the path the
+    byte floor describes; prefill is the one-off outside it)."""
+    from distributeddeeplearning_tpu.serving import ReqSpec, SlotEngine
+
+    max_len = prompt_len + new_tokens
+    engine = SlotEngine(
+        model, params, num_slots=b, max_len=max_len,
+        buckets=(prompt_len,), kv_layout="paged", block_size=block_size,
+        prefix_cache=False,
+    )
+    engine.warmup()
+    rng = np.random.RandomState(0)
+    total = t_meas = 0.0
+    for rep in range(reps + 1):  # rep 0 = warmup, untimed
+        for slot in list(engine.active_slots):
+            engine.release(slot)
+        for slot in range(b):
+            spec = ReqSpec(
+                prompt=rng.randint(0, vocab, size=(prompt_len,)).astype(
+                    np.int32
+                ),
+                max_new_tokens=new_tokens,
+                temperature=0.8, top_k=40, rng=rep * b + slot,
+            )
+            engine.validate_spec(spec)
+            engine.prefill(slot, spec)
+        engine.decode_step()  # fence: first batched step dispatched
+        t0 = time.perf_counter()
+        # prefill + the fence step emitted 2 of new_tokens already
+        steps = max(new_tokens - 2, 1)
+        for _ in range(steps):
+            engine.decode_step()
+        dt = time.perf_counter() - t0
+        if rep:
+            total += b * steps
+            t_meas += dt
+    return total / t_meas
+
+
 def audit(model_name: str, prompt_len: int, new_tokens: int,
-          batches, profile_dir=None, vocab: int = 32000):
+          batches, profile_dir=None, vocab: int = 32000,
+          kv_layout: str = "dense", block_size: int = 16):
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -130,36 +223,49 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
     import contextlib
 
     for i, b in enumerate(batches):
-        kv = cache_bytes(b)
-        bytes_per_step = param_bytes + kv
-        floor = b * HBM_GBPS * 1e9 / bytes_per_step
-        rng = np.random.RandomState(0)
-        prompt = rng.randint(0, vocab, size=(b, prompt_len)).astype(np.int32)
-        kw = dict(max_new_tokens=new_tokens, temperature=0.8, top_k=40,
-                  rng=jax.random.PRNGKey(1))
-        out = generate(model, params, prompt, **kw)  # compile + warmup
-        int(np.asarray(out)[0, -1])
-        prof = (
-            jax.profiler.trace(os.path.join(profile_dir, f"b{b}"))
-            if profile_dir else contextlib.nullcontext()
-        )
-        reps = 3
-        with prof:
-            t0 = time.perf_counter()
-            for r in range(reps):
-                out = generate(model, params, prompt,
-                               **{**kw, "rng": jax.random.PRNGKey(2 + r)})
-            int(np.asarray(out)[0, -1])  # host readback fence
-            dt = time.perf_counter() - t0
-        tps = reps * b * new_tokens / dt
-        row = sweep_row(b, tps, kv, bytes_per_step, floor, on_tpu)
+        table_bytes = 0
+        if kv_layout == "paged":
+            kv, table_bytes = paged_step_bytes(model, b, max_len, block_size)
+            bytes_per_step = param_bytes + kv + table_bytes
+            floor = b * HBM_GBPS * 1e9 / bytes_per_step
+            tps = measure_paged(
+                model, params, b, prompt_len, new_tokens, block_size, vocab
+            )
+        else:
+            kv = cache_bytes(b)
+            bytes_per_step = param_bytes + kv
+            floor = b * HBM_GBPS * 1e9 / bytes_per_step
+            rng = np.random.RandomState(0)
+            prompt = rng.randint(0, vocab, size=(b, prompt_len)).astype(
+                np.int32
+            )
+            kw = dict(max_new_tokens=new_tokens, temperature=0.8, top_k=40,
+                      rng=jax.random.PRNGKey(1))
+            out = generate(model, params, prompt, **kw)  # compile + warmup
+            int(np.asarray(out)[0, -1])
+            prof = (
+                jax.profiler.trace(os.path.join(profile_dir, f"b{b}"))
+                if profile_dir else contextlib.nullcontext()
+            )
+            reps = 3
+            with prof:
+                t0 = time.perf_counter()
+                for r in range(reps):
+                    out = generate(model, params, prompt,
+                                   **{**kw, "rng": jax.random.PRNGKey(2 + r)})
+                int(np.asarray(out)[0, -1])  # host readback fence
+                dt = time.perf_counter() - t0
+            tps = reps * b * new_tokens / dt
+        row = sweep_row(b, tps, kv, bytes_per_step, floor, on_tpu,
+                        table_bytes=table_bytes)
         rows.append(row)
         print(format_row(row), flush=True)
-    return {
+    out = {
         "audit": f"{model_name}_decode",
         "platform": platform,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "kv_layout": kv_layout,
         "param_bytes_mb": round(param_bytes / 2**20, 1),
         "hbm_gbps": HBM_GBPS,
         "floor_basis": FLOOR_BASIS,
@@ -168,6 +274,9 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
         "floor_applicable": on_tpu,
         "sweep": rows,
     }
+    if kv_layout == "paged":
+        out["block_size"] = block_size
+    return out
 
 
 def main(argv=None) -> int:
@@ -181,11 +290,15 @@ def main(argv=None) -> int:
     p.add_argument("--new-tokens", type=int, default=128)
     p.add_argument("--batches", default="1,2,4,8,16,32,64")
     p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--kv-layout", choices=("dense", "paged"),
+                   default="dense")
+    p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--profile-dir", default=None)
     args = p.parse_args(argv)
     batches = [int(b) for b in args.batches.split(",") if b.strip()]
     out = audit(args.model, args.prompt_len, args.new_tokens, batches,
-                profile_dir=args.profile_dir, vocab=args.vocab)
+                profile_dir=args.profile_dir, vocab=args.vocab,
+                kv_layout=args.kv_layout, block_size=args.block_size)
     print(json.dumps(out))
     return 0
 

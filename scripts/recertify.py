@@ -17,6 +17,8 @@ Protocols (all via bench.py's existing modes — no new measurement code):
     lm_moe_small  BENCH_MODEL=lm_moe_small             tokens/sec
     decode        BENCH_DECODE=1 (b=8, 128+128)        tokens/sec
     serve_lm      scripts/serve_bench.py (32k vocab)   tokens/sec
+    serve_lm_paged  serve_bench dense-vs-paged A/B at  tokens/sec
+                    a fixed pool-byte budget (longtail)
 
 Usage::
 
@@ -73,6 +75,19 @@ PROTOCOLS = {
         "SERVE_REQUESTS": "32", "SERVE_MAX_NEW": "16",
         "SERVE_RATE_RPS": "200", "SERVE_SLOTS": "8", "SERVE_BUCKETS": "8,16",
     },
+    # Paged KV pool headline (docs/SERVING.md): dense vs paged at the
+    # SAME pool-byte budget on the long-tail length mix — the row's JSON
+    # line carries both runs, capacity_ratio and tps_ratio, and the
+    # script exits non-zero unless paged reaches >=2x concurrency (or
+    # >=1.5x tokens/sec) with bitwise parity and zero recompiles.
+    "serve_lm_paged": {
+        "_script": "scripts/serve_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_KV_LAYOUT": "compare", "SERVE_PROFILE": "longtail",
+        "SERVE_REQUESTS": "32", "SERVE_RATE_RPS": "0",
+        "SERVE_SLOTS": "16", "SERVE_POOL_SLOT_BUDGET": "4",
+        "SERVE_BLOCK_SIZE": "16",
+    },
 }
 
 
@@ -87,6 +102,8 @@ _PROTOCOL_VARS = (
     "BENCH_VOCAB", "SERVE_REQUESTS", "SERVE_MAX_NEW", "SERVE_RATE_RPS",
     "SERVE_SLOTS", "SERVE_BUCKETS", "SERVE_QUEUE_DEPTH", "SERVE_SEED",
     "SERVE_DEADLINE_MS", "SERVE_PREFILLS_PER_STEP", "SERVE_TOP_K_CAP",
+    "SERVE_KV_LAYOUT", "SERVE_PROFILE", "SERVE_BLOCK_SIZE",
+    "SERVE_NUM_BLOCKS", "SERVE_PREFIX_CACHE", "SERVE_POOL_SLOT_BUDGET",
 )
 
 

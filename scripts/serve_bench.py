@@ -1,28 +1,38 @@
-"""Continuous-batching serving benchmark — Poisson load vs sequential.
+"""Continuous-batching serving benchmark — Poisson load vs sequential,
+dense vs paged KV pool at a fixed byte budget.
 
 The serving tier's certifiable protocol (BASELINE.md style, one JSON
-line on stdout): a seeded Poisson arrival stream of mixed-length
-requests is served twice —
+line on stdout). A seeded Poisson arrival stream of mixed-length
+requests is served by up to three configurations:
 
 * **sequential baseline**: one request at a time through
   ``inference.generate`` (each distinct shape warmed first, so the
-  comparison is pure steady-state throughput — the per-shape compiles
-  the slot engine avoids are reported separately, not smuggled into the
-  denominator);
-* **continuous batching**: the same requests submitted to
-  ``serving.Server`` on their arrival schedule, drained to completion.
-
-The record carries throughput (the headline ``value``), the sequential
-baseline and speedup, TTFT/queue-wait percentiles, mean slot occupancy
-and the engine's compile count — everything
-``scripts/recertify.py``'s ``serve_lm`` row needs to re-certify the
-protocol on hardware the moment the relay returns.
+  comparison is pure steady-state throughput);
+* **continuous batching** on the selected KV layout
+  (``SERVE_KV_LAYOUT=dense|paged``): the same requests submitted to
+  ``serving.Server`` on their arrival schedule, drained to completion;
+* **compare** (``SERVE_KV_LAYOUT=compare``): dense AND paged engines at
+  the SAME pool-byte budget — the dense pool holds
+  ``SERVE_POOL_SLOT_BUDGET`` full ``max_len`` rows; the paged pool gets
+  exactly those bytes as blocks (`budget_tokens / block_size` blocks +
+  the trash block) but serves ``SERVE_SLOTS`` decode rows. On the
+  long-tail length mix (``SERVE_PROFILE=longtail``) most requests need
+  a fraction of ``max_len``, so block-granular admission sustains a
+  multiple of the dense concurrency from the same HBM. The record
+  carries both runs' throughput/concurrency and the script exits
+  non-zero unless paged reaches ≥2× dense peak concurrency (or ≥1.5×
+  tokens/sec) with bitwise per-request parity and zero mid-measure
+  recompiles on BOTH engines.
 
 Env knobs (defaults in parentheses): ``SERVE_SLOTS`` (8),
-``SERVE_BUCKETS`` ("8,16"), ``SERVE_REQUESTS`` (32),
-``SERVE_MAX_NEW`` (16), ``SERVE_RATE_RPS`` (200 — Poisson arrival
-rate; 0 = closed backlog, all at t=0), ``SERVE_SEED`` (0),
-``BENCH_MODEL`` (lm_tiny), ``BENCH_VOCAB`` (256), plus the generic
+``SERVE_BUCKETS`` ("8,16"; compare/longtail default covers the long
+tail), ``SERVE_REQUESTS`` (32), ``SERVE_MAX_NEW`` (16),
+``SERVE_RATE_RPS`` (200 — Poisson arrival rate; 0 = closed backlog,
+all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail),
+``SERVE_KV_LAYOUT`` (dense | paged | compare), ``SERVE_BLOCK_SIZE``
+(16), ``SERVE_NUM_BLOCKS`` (0 = dense-equivalent),
+``SERVE_POOL_SLOT_BUDGET`` (4 — the fixed byte budget, in dense slots),
+``BENCH_MODEL`` (lm_tiny), ``BENCH_VOCAB`` (32000), plus the generic
 ``OBS_DIR``/``--events`` and ``COMPILATION_CACHE_DIR`` plumbing
 bench.py uses.
 
@@ -39,6 +49,21 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Request-shape mixes: (prompt_len, max_new) pairs cycled over the
+# request stream. "longtail" is the production-shaped distribution the
+# paged pool exists for — mostly short prompts, a thin tail of long
+# ones — kept to few distinct shapes so the sequential baseline's
+# per-shape warmup stays bounded.
+PROFILES = {
+    "mixed": None,  # legacy: prompt_lens cycle, SERVE_MAX_NEW everywhere
+    "longtail": (
+        [(3, 8)] * 8 + [(4, 8)] * 6 + [(6, 8)] * 5 + [(8, 8)] * 4
+        + [(12, 16)] * 3 + [(16, 16)] * 2
+        + [(24, 16), (48, 24), (96, 32)]
+    ),
+}
+MIXED_PROMPT_LENS = (4, 7, 12, 5, 16, 3, 9, 14)
 
 
 def _percentile(vals, q):
@@ -60,23 +85,25 @@ def _emit_record(record: dict) -> None:
     bus.flush()
 
 
-def build_requests(n, rate_rps, max_new, seed, vocab, prompt_lens):
-    """Seeded request set + Poisson arrival offsets (seconds). Mixed
-    prompt lengths, per-request sampling seeds — the adversarial mix
-    the parity oracle certifies, at load."""
+def build_requests(n, rate_rps, seed, vocab, shapes):
+    """Seeded request set + Poisson arrival offsets (seconds) over the
+    (prompt_len, max_new) shape mix — mixed lengths, per-request
+    sampling seeds: the adversarial mix the parity oracle certifies,
+    at load."""
     import numpy as np
 
     rng = np.random.RandomState(seed)
+    order = rng.permutation(len(shapes))
     reqs = []
     t = 0.0
     for i in range(n):
         if rate_rps > 0:
             t += float(rng.exponential(1.0 / rate_rps))
-        tp = int(prompt_lens[i % len(prompt_lens)])
+        tp, max_new = shapes[order[i % len(shapes)]]
         reqs.append({
             "arrival_s": t,
             "prompt": rng.randint(0, vocab, size=(tp,)).astype(np.int32),
-            "max_new": max_new,
+            "max_new": int(max_new),
             "seed": int(rng.randint(0, 2**31 - 1)),
         })
     return reqs
@@ -134,6 +161,72 @@ def run_continuous(server, reqs, temperature, top_k):
     return tokens / dt, handles, dt
 
 
+def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
+                     queue_depth, prefills_per_step, temperature, top_k):
+    """Build + warm one engine, replay the request schedule through it,
+    and report throughput, concurrency, latency percentiles, parity
+    against the sequential outputs and the compile ledger."""
+    import numpy as np
+
+    from distributeddeeplearning_tpu.serving import Server, SlotEngine
+
+    engine = SlotEngine(model, params, **engine_kwargs)
+    engine.warmup()
+    server = Server(
+        engine, queue_depth=max(queue_depth, len(reqs)),
+        prefills_per_step=prefills_per_step,
+    )
+    # Warm pass: one request end-to-end so first-dispatch overheads
+    # (host transfers, executable load) stay out of the measurement.
+    run_continuous(server, reqs[:1], temperature, top_k)
+    compile_count_pre = engine.compile_count
+    server.stats["peak_active"] = 0
+
+    tps, handles, wall_s = run_continuous(server, reqs, temperature, top_k)
+
+    parity = all(
+        np.array_equal(h.tokens, seq_outs[i][: len(h.tokens)])
+        for i, h in enumerate(handles)
+    )
+    ttft_ms = [h.ttft_s * 1e3 for h in handles if h.ttft_s is not None]
+    qwait_ms = [
+        h.queue_wait_s * 1e3 for h in handles
+        if h.queue_wait_s is not None
+    ]
+    out = {
+        "kv_layout": engine.kv_layout,
+        "tokens_per_sec": round(tps, 1),
+        "parity": bool(parity),
+        "slots": engine.num_slots,
+        "peak_concurrent": server.stats["peak_active"],
+        "ttft_p50_ms": round(_percentile(ttft_ms, 0.5), 2),
+        "ttft_p99_ms": round(_percentile(ttft_ms, 0.99), 2),
+        "queue_wait_p50_ms": round(_percentile(qwait_ms, 0.5), 2),
+        "queue_wait_p99_ms": round(_percentile(qwait_ms, 0.99), 2),
+        "slot_occupancy_mean": round(server.occupancy_mean, 3),
+        "decode_steps": server.stats["decode_steps"],
+        "compile_count": engine.compile_count,
+        "programs_expected": len(engine.buckets) + 1,
+        "compiles_during_measure": engine.compile_count - compile_count_pre,
+        "wall_s": round(wall_s, 2),
+    }
+    if engine.allocator is not None:
+        snap = engine.allocator.snapshot()
+        out["pool"] = {
+            "block_size": engine.block_size,
+            "capacity_blocks": snap["capacity"],
+            "prefix_hit_blocks": snap["prefix_hit_blocks"],
+            "evicted": snap["evicted"],
+            # utilization at peak demand: how much of the byte budget
+            # actually held live KV when the pool was busiest
+            "peak_live_blocks": snap["peak_live"],
+            "peak_utilization": round(
+                snap["peak_live"] / snap["capacity"], 3
+            ) if snap["capacity"] else 0.0,
+        }
+    return out
+
+
 def main() -> int:
     if "--events" in sys.argv[1:] or os.environ.get("OBS_DIR"):
         from distributeddeeplearning_tpu import obs
@@ -156,12 +249,9 @@ def main() -> int:
 
     import flax.linen as nn
     import jax.numpy as jnp
-    import numpy as np
 
     from distributeddeeplearning_tpu.models import get_model
-    from distributeddeeplearning_tpu.serving import (
-        Server, ServeConfig, SlotEngine,
-    )
+    from distributeddeeplearning_tpu.serving import ServeConfig
 
     env = os.environ
     model_name = env.get("BENCH_MODEL", "lm_tiny")
@@ -174,12 +264,23 @@ def main() -> int:
     max_new = int(env.get("SERVE_MAX_NEW", "16"))
     rate_rps = float(env.get("SERVE_RATE_RPS", "200"))
     seed = int(env.get("SERVE_SEED", "0"))
-    prompt_lens = (4, 7, 12, 5, 16, 3, 9, 14)
+    profile = env.get("SERVE_PROFILE", "mixed")
+    layout = env.get("SERVE_KV_LAYOUT", "dense")
+    budget_slots = int(env.get("SERVE_POOL_SLOT_BUDGET", "4"))
+    if profile not in PROFILES:
+        raise SystemExit(f"unknown SERVE_PROFILE {profile!r}")
+    if layout not in ("dense", "paged", "compare"):
+        raise SystemExit(f"unknown SERVE_KV_LAYOUT {layout!r}")
+    shapes = PROFILES[profile] or [(tp, max_new) for tp in MIXED_PROMPT_LENS]
     cfg = ServeConfig.from_env()
     if cfg.buckets is None:
-        cfg.buckets = (8, 16)
-    max_len = max(prompt_lens) + max_new
+        cfg.buckets = (8, 16) if profile == "mixed" else (8, 16, 32, 64, 96)
+    max_len = max(tp + n_new for tp, n_new in shapes)
     temperature, top_k = 0.8, 40
+    metric = (
+        "serve_paged_vs_dense_capacity" if layout == "compare"
+        else "serve_continuous_tokens_per_sec"
+    )
 
     try:
         model = get_model(
@@ -191,77 +292,109 @@ def main() -> int:
             train=False,
         )
         params = nn.unbox(variables["params"])
-        reqs = build_requests(
-            n_requests, rate_rps, max_new, seed, vocab, prompt_lens
-        )
+        reqs = build_requests(n_requests, rate_rps, seed, vocab, shapes)
 
         seq_tps, seq_outs, seq_shapes = run_sequential(
             model, params, reqs, temperature, top_k
         )
 
-        engine = SlotEngine(
-            model, params, num_slots=cfg.num_slots, max_len=max_len,
-            buckets=cfg.buckets,
+        budget_tokens = budget_slots * max_len
+        paged_kwargs = dict(
+            num_slots=cfg.num_slots, max_len=max_len, buckets=cfg.buckets,
+            kv_layout="paged", block_size=cfg.block_size,
+            num_blocks=(
+                cfg.num_blocks or budget_tokens // cfg.block_size + 1
+            ),
+            prefix_cache=cfg.prefix_cache,
         )
-        engine.warmup()
-        server = Server(
-            engine, queue_depth=max(cfg.queue_depth, n_requests),
-            prefills_per_step=cfg.prefills_per_step,
-        )
-        # Warm pass: one request end-to-end so first-dispatch overheads
-        # (host transfers, executable load) stay out of the measurement.
-        run_continuous(server, reqs[:1], temperature, top_k)
-        compile_count_pre = engine.compile_count
+        runs = {}
+        if layout in ("dense", "compare"):
+            runs["dense"] = serve_one_engine(
+                model, params, reqs, seq_outs,
+                engine_kwargs=dict(
+                    num_slots=(
+                        budget_slots if layout == "compare"
+                        else cfg.num_slots
+                    ),
+                    max_len=max_len, buckets=cfg.buckets,
+                ),
+                queue_depth=cfg.queue_depth,
+                prefills_per_step=cfg.prefills_per_step,
+                temperature=temperature, top_k=top_k,
+            )
+        if layout in ("paged", "compare"):
+            runs["paged"] = serve_one_engine(
+                model, params, reqs, seq_outs,
+                engine_kwargs=paged_kwargs,
+                queue_depth=cfg.queue_depth,
+                prefills_per_step=cfg.prefills_per_step,
+                temperature=temperature, top_k=top_k,
+            )
 
-        cont_tps, handles, wall_s = run_continuous(
-            server, reqs, temperature, top_k
-        )
-
-        # Per-request parity against the sequential outputs — the bench
-        # itself proves the speedup is not buying different tokens.
-        parity = all(
-            np.array_equal(h.tokens, seq_outs[i][: len(h.tokens)])
-            for i, h in enumerate(handles)
-        )
-        ttft_ms = [h.ttft_s * 1e3 for h in handles if h.ttft_s is not None]
-        qwait_ms = [
-            h.queue_wait_s * 1e3 for h in handles
-            if h.queue_wait_s is not None
-        ]
-        record = {
-            "metric": "serve_continuous_tokens_per_sec",
-            "value": round(cont_tps, 1),
-            "unit": "tokens/sec",
-            "vs_baseline": round(cont_tps / seq_tps, 2) if seq_tps else 0.0,
-            "detail": {
-                "sequential_tokens_per_sec": round(seq_tps, 1),
-                "speedup_vs_sequential": round(cont_tps / seq_tps, 2)
-                if seq_tps else 0.0,
-                "parity": bool(parity),
-                "requests": n_requests,
-                "slots": cfg.num_slots,
-                "buckets": list(cfg.buckets),
-                "rate_rps": rate_rps,
-                "max_new_tokens": max_new,
-                "ttft_p50_ms": round(_percentile(ttft_ms, 0.5), 2),
-                "ttft_p99_ms": round(_percentile(ttft_ms, 0.99), 2),
-                "queue_wait_p50_ms": round(_percentile(qwait_ms, 0.5), 2),
-                "queue_wait_p99_ms": round(_percentile(qwait_ms, 0.99), 2),
-                "slot_occupancy_mean": round(server.occupancy_mean, 3),
-                "decode_steps": server.stats["decode_steps"],
-                "compile_count": engine.compile_count,
-                "compiles_during_measure": engine.compile_count
-                - compile_count_pre,
-                "sequential_compiled_shapes": seq_shapes,
-                "wall_s": round(wall_s, 2),
-                "platform": jax.devices()[0].platform,
-            },
+        detail = {
+            "profile": profile,
+            "requests": n_requests,
+            "buckets": list(cfg.buckets),
+            "rate_rps": rate_rps,
+            "max_len": max_len,
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "sequential_compiled_shapes": seq_shapes,
+            "platform": jax.devices()[0].platform,
         }
+        parity = all(r["parity"] for r in runs.values())
+        clean = all(r["compiles_during_measure"] == 0 for r in runs.values())
+        closed = all(
+            r["compile_count"] == r["programs_expected"]
+            for r in runs.values()
+        )
+        if layout == "compare":
+            dense, paged = runs["dense"], runs["paged"]
+            capacity_ratio = (
+                paged["peak_concurrent"] / dense["peak_concurrent"]
+                if dense["peak_concurrent"] else 0.0
+            )
+            tps_ratio = (
+                paged["tokens_per_sec"] / dense["tokens_per_sec"]
+                if dense["tokens_per_sec"] else 0.0
+            )
+            detail.update({
+                "pool_budget_tokens": budget_tokens,
+                "dense": dense,
+                "paged": paged,
+                "capacity_ratio": round(capacity_ratio, 2),
+                "tps_ratio": round(tps_ratio, 2),
+                "parity": parity,
+            })
+            record = {
+                "metric": metric,
+                # headline: paged throughput at the shared byte budget
+                "value": paged["tokens_per_sec"],
+                "unit": "tokens/sec",
+                "vs_baseline": round(tps_ratio, 2),
+            }
+            ok = (
+                parity and clean and closed
+                and (capacity_ratio >= 2.0 or tps_ratio >= 1.5)
+            )
+        else:
+            run = runs[layout]
+            detail.update(run)
+            detail["speedup_vs_sequential"] = (
+                round(run["tokens_per_sec"] / seq_tps, 2) if seq_tps else 0.0
+            )
+            record = {
+                "metric": metric,
+                "value": run["tokens_per_sec"],
+                "unit": "tokens/sec",
+                "vs_baseline": detail["speedup_vs_sequential"],
+            }
+            ok = parity and clean and closed
+        record["detail"] = detail
         _emit_record(record)
-        return 0 if parity and record["detail"]["compiles_during_measure"] == 0 else 1
+        return 0 if ok else 1
     except Exception as e:  # structured failure record, like bench.py
         _emit_record({
-            "metric": "serve_continuous_tokens_per_sec", "value": 0.0,
+            "metric": metric, "value": 0.0,
             "unit": "tokens/sec", "vs_baseline": 0.0, "error": repr(e),
         })
         raise

@@ -401,3 +401,52 @@ def test_decode_audit_cpu_honest_rows():
     assert off_chip["analytic_floor_tokens_per_sec"] == 20000.0
     assert "%" in format_row(on_chip)
     assert "n/a" in format_row(off_chip)
+
+
+def test_decode_audit_paged_floor_accounts_table_bytes():
+    """Paged-mode byte floor (ISSUE 6 satellite): the analytic
+    bytes/step must stream the table-gathered K/V view (block-rounded)
+    PLUS the int32 block tables — leaving the tables out would overstate
+    pct_of_floor in paged mode. Shape-only, no compile."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import traverse_util
+
+    from distributeddeeplearning_tpu.inference import decode_variant
+    from distributeddeeplearning_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from scripts.decode_audit import paged_step_bytes, sweep_row
+
+    model = TransformerLM(
+        variant="tiny", vocab_size=64, max_seq_len=16, dtype=jnp.float32
+    )
+    shapes = jax.eval_shape(
+        lambda r: decode_variant(model).init(
+            r, jnp.zeros((2, 16), jnp.int32), train=False
+        ),
+        jax.random.PRNGKey(0),
+    )["cache"]
+    dense_kv = sum(
+        math.prod(s.shape) * np.dtype(s.dtype).itemsize
+        for p, s in traverse_util.flatten_dict(dict(shapes)).items()
+        if p[-1] in ("cached_k", "cached_v")
+    )
+    view, table = paged_step_bytes(model, 2, 16, block_size=4)
+    # block-aligned max_len: the gathered view streams exactly the dense
+    # KV bytes — the floor differs ONLY by the table overhead
+    assert view == dense_kv
+    assert table > 0
+    # non-dividing block size: rounding makes the view strictly larger
+    view5, _ = paged_step_bytes(model, 2, 16, block_size=5)
+    assert view5 > dense_kv
+    # the row itemizes the table bytes already inside bytes_per_step
+    row = sweep_row(2, 100.0, view, view + table, 1000.0, False,
+                    table_bytes=table)
+    assert row["block_table_bytes"] == table
+    assert "block_table_bytes" not in sweep_row(
+        2, 100.0, dense_kv, dense_kv, 1000.0, False
+    )

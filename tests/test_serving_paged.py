@@ -1,0 +1,489 @@
+"""Paged KV-cache pool + prefix-caching oracles (serving/blocks.py,
+the paged SlotEngine layout, scheduler block gating).
+
+Three claims, all pinned here:
+
+1. **Allocator invariants** — alloc/free/refcount/copy-on-write ledger
+   arithmetic, trash-block reservation, LRU retention + eviction of
+   zero-ref prefix-cached blocks, all-or-nothing exhaustion.
+2. **Parity** — a request decoded through the paged pool emits *bitwise*
+   the tokens sequential ``inference.generate`` emits, under the same
+   adversarial co-scheduling the dense oracles stage (staggered joins,
+   mixed buckets, mid-stream cancellation, mixed greedy/sampled) — and
+   the program set stays closed at ``len(buckets) + 1`` with zero
+   backend compiles across the churn.
+3. **Prefix sharing** — a request whose prompt shares a block-aligned
+   prefix with a cached one maps its leading table entries to the SAME
+   physical blocks, prefills only the divergent suffix (the shared
+   blocks are written exactly once — their bytes are bitwise unchanged
+   by the second prefill), and still emits bitwise-identical tokens to
+   an unshared run. Block exhaustion holds requests at the queue head
+   (FIFO) and surfaces as ``QueueFull`` backpressure at submit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.inference import generate
+from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+from distributeddeeplearning_tpu.serving import (
+    BlockAllocator,
+    BlockPoolExhausted,
+    QueueFull,
+    ReqSpec,
+    Request,
+    ServeConfig,
+    Server,
+    SlotEngine,
+)
+from distributeddeeplearning_tpu.serving.blocks import (
+    TRASH_BLOCK,
+    hash_prefix_chain,
+)
+
+VOCAB, MAX_LEN = 64, 32
+BUCKETS = (4, 8, 16)
+BLOCK = 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=MAX_LEN,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import flax.linen as nn
+
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, MAX_LEN), jnp.int32),
+        train=False,
+    )
+    return nn.unbox(variables["params"])
+
+
+@pytest.fixture(scope="module")
+def _engine(model, params):
+    eng = SlotEngine(
+        model, params, num_slots=4, max_len=MAX_LEN, buckets=BUCKETS,
+        kv_layout="paged", block_size=BLOCK,
+    )
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def engine(_engine):
+    """The shared warmed paged engine, guaranteed empty per test."""
+    for s in _engine.active_slots:
+        _engine.release(s)
+    yield _engine
+    for s in _engine.active_slots:
+        _engine.release(s)
+
+
+def _prompt(rng, n):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _assert_request_parity(h, model, params):
+    r = h.request
+    rng = (
+        jax.random.PRNGKey(r.rng) if isinstance(r.rng, (int, np.integer))
+        else (None if r.rng is None else jnp.asarray(r.rng, jnp.uint32))
+    )
+    ref = np.asarray(generate(
+        model, params, np.asarray(r.prompt, np.int32)[None],
+        max_new_tokens=r.max_new_tokens, temperature=r.temperature,
+        top_k=r.top_k, top_p=r.top_p, eos_token=r.eos_token, rng=rng,
+    ))[0]
+    got = h.tokens
+    assert got.shape[0] <= ref.shape[0], (got.shape, ref.shape)
+    np.testing.assert_array_equal(got, ref[: got.shape[0]])
+
+
+def _paged_k_blocks(engine, block_ids):
+    """Bitwise snapshot of the given physical blocks across every
+    layer's K pool."""
+    idx = np.asarray(block_ids)
+    flat = engine._flatten(engine._unfreeze(engine._pool))
+    return {
+        "/".join(p): np.asarray(leaf[idx])
+        for p, leaf in flat.items()
+        if p[-1] in ("paged_k", "paged_v")
+    }
+
+
+# -- allocator ledger ------------------------------------------------------
+
+
+def test_allocator_basic_and_trash_reserved():
+    a = BlockAllocator(num_blocks=6, block_size=4)
+    assert a.capacity == 5 and a.free_count == 5
+    got = a.alloc(5)
+    assert TRASH_BLOCK not in got
+    assert sorted(got) == [1, 2, 3, 4, 5]
+    assert a.free_count == 0 and a.live_count == 5
+    with pytest.raises(BlockPoolExhausted):
+        a.alloc(1)
+    for b in got:
+        a.decref(b)
+    assert a.free_count == 5 and a.live_count == 0
+    assert a.blocks_for_tokens(0) == 0
+    assert a.blocks_for_tokens(1) == 1
+    assert a.blocks_for_tokens(4) == 1
+    assert a.blocks_for_tokens(5) == 2
+
+
+def test_allocator_refcount_and_prefix_match():
+    a = BlockAllocator(num_blocks=8, block_size=2)
+    toks = np.arange(6, dtype=np.int32)
+    bids = a.alloc(3)
+    assert a.register_prefix(toks, bids) == 3
+    # a second registration of the same content is a no-op
+    other = a.alloc(3)
+    assert a.register_prefix(toks, other) == 0
+    # matching refs the SAME physical blocks
+    m = a.match_prefix(toks, max_tokens=6)
+    assert m == bids
+    assert all(a.refcount(b) == 2 for b in bids)
+    # the max_tokens cap stops the chain early (serving caps at t-1)
+    a.release_match(m)
+    m = a.match_prefix(toks, max_tokens=5)
+    assert m == bids[:2]
+    a.release_match(m)
+    # divergent content after block 0 only matches the agreeing prefix
+    toks2 = np.array([0, 1, 9, 9, 4, 5], np.int32)
+    m = a.match_prefix(toks2, max_tokens=6)
+    assert m == bids[:1]
+    a.release_match(m)
+
+
+def test_allocator_lru_retention_and_eviction():
+    a = BlockAllocator(num_blocks=4, block_size=2)  # 3 usable
+    toks = np.arange(6, dtype=np.int32)
+    bids = a.alloc(3)
+    a.register_prefix(toks, bids)
+    for b in bids:
+        a.decref(b)
+    # zero-ref but registered: retained, still matchable AND allocatable
+    assert a.free_count == 3 and a.live_count == 0
+    m = a.match_prefix(toks, max_tokens=6)
+    assert m == bids
+    for b in m:
+        a.decref(b)
+    # allocation pressure evicts LRU-first and drops the hash mapping
+    fresh = a.alloc(2)
+    assert set(fresh) == set(bids[:2])
+    assert a.stats["evicted"] == 2
+    assert a.match_prefix(toks, max_tokens=6) == []  # chain broken at 0
+    with pytest.raises(BlockPoolExhausted):
+        a.alloc(2)  # only the last cached block remains
+
+
+def test_allocator_copy_on_write():
+    a = BlockAllocator(num_blocks=6, block_size=2)
+    toks = np.arange(4, dtype=np.int32)
+    bids = a.alloc(2)
+    a.register_prefix(toks, bids)
+    # shared block: writer gets a FRESH block, sharer keeps the original
+    a.incref(bids[0])
+    private = a.ensure_private(bids[0])
+    assert private != bids[0]
+    assert a.refcount(bids[0]) == 1 and a.refcount(private) == 1
+    assert a.stats["cow"] == 1
+    # exclusive-but-registered block: unregistered in place (its cached
+    # content is about to change), same id back
+    assert a.ensure_private(bids[1]) == bids[1]
+    assert a.match_prefix(toks, max_tokens=4) == [bids[0]]
+    a.release_match([bids[0]])
+    # exclusive unregistered block: identity
+    assert a.ensure_private(private) == private
+
+
+def test_hash_chain_is_position_dependent():
+    bs = 4
+    t1 = np.arange(8, dtype=np.int32)
+    t2 = np.concatenate([np.arange(4, 8), np.arange(4)]).astype(np.int32)
+    h1, h2 = hash_prefix_chain(t1, bs), hash_prefix_chain(t2, bs)
+    assert len(h1) == 2 and len(h2) == 2
+    assert h1[0] != h2[0]          # content differs
+    assert h1[1] != h2[1]          # same bytes, different prefix -> differs
+    assert hash_prefix_chain(t1[:7], bs) == h1[:1]  # partial tail excluded
+
+
+# -- paged engine parity ---------------------------------------------------
+
+
+def test_paged_parity_greedy_staggered_mixed_lengths(engine, model, params):
+    """The dense tier's flagship oracle on the paged pool: 8 greedy
+    requests over 4 slots, mixed buckets, staggered joins — bitwise."""
+    rng = np.random.RandomState(0)
+    server = Server(engine, prefills_per_step=1)
+    handles = [
+        server.submit(Request(prompt=_prompt(rng, n), max_new_tokens=m))
+        for n, m in [(3, 6), (7, 9), (12, 4), (16, 10),
+                     (4, 12), (9, 3), (14, 7), (5, 5)]
+    ]
+    server.drain()
+    assert all(h.status == "done" for h in handles)
+    for h in handles:
+        _assert_request_parity(h, model, params)
+    # every block returned (some parked in the prefix cache, all free)
+    assert engine.allocator.live_count == 0
+    assert engine.allocator.free_count == engine.allocator.capacity
+
+
+def test_paged_sampled_churn_zero_recompiles(engine, model, params):
+    """Seeded sampling + cancellation churn on the paged pool: zero
+    backend compiles, closed program set, every stream bitwise."""
+    from jax._src import monitoring
+
+    compiles = []
+    monitoring.register_event_duration_secs_listener(
+        lambda event, duration, **kw: compiles.append(event)
+        if "backend_compile" in event else None
+    )
+    baseline = len(compiles)
+
+    rng = np.random.RandomState(1)
+    server = Server(engine, prefills_per_step=2)
+    mk = lambda n, m, seed, **kw: server.submit(Request(  # noqa: E731
+        prompt=_prompt(rng, n), max_new_tokens=m, rng=seed, **kw
+    ))
+    wave1 = [
+        mk(3, 10, 11, temperature=0.9, top_k=8),
+        mk(8, 12, 12, temperature=0.7, top_k=5),
+        mk(13, 12, 13),
+        mk(16, 8, 14, temperature=1.1, top_k=40, top_p=0.9),
+    ]
+    for _ in range(4):
+        server.step()
+    victim = wave1[1]
+    victim.cancel()
+    wave2 = [
+        mk(5, 9, 21, temperature=0.8, top_k=6),
+        mk(10, 6, 22, temperature=1.0, top_p=0.8),
+    ]
+    server.drain()
+    assert len(compiles) == baseline, compiles[baseline:]
+    assert engine.compile_count == len(BUCKETS) + 1
+    assert victim.status == "cancelled"
+    assert 0 < len(victim.new_tokens) < victim.request.max_new_tokens
+    for h in wave1 + wave2:
+        _assert_request_parity(h, model, params)
+
+
+def test_paged_generate_engine_routing_bitwise(engine, model, params):
+    """The drop-in generate(engine=...) route over the paged pool."""
+    rng = np.random.RandomState(4)
+    server = Server(engine)
+    p1 = rng.randint(0, VOCAB, size=(1, 6)).astype(np.int32)
+    for kw in (
+        dict(),
+        dict(temperature=0.8, top_k=7, rng=jax.random.PRNGKey(3)),
+    ):
+        ref = np.asarray(generate(model, params, p1, max_new_tokens=8, **kw))
+        got = np.asarray(generate(model, params, p1, max_new_tokens=8,
+                                  engine=server, **kw))
+        np.testing.assert_array_equal(got, ref)
+
+
+# -- prefix-sharing oracle -------------------------------------------------
+
+
+def test_prefix_sharing_oracle(engine, model, params):
+    """Two requests sharing a 12-token prompt: the second maps its two
+    leading table entries to the FIRST request's physical blocks,
+    prefills only the 4-token suffix (bucket 4, not 16), leaves the
+    shared blocks bitwise untouched — and both emit exactly what
+    unshared sequential generate emits."""
+    rng = np.random.RandomState(7)
+    prompt = _prompt(rng, 12)
+    server = Server(engine)
+
+    hA = server.submit(Request(
+        prompt=prompt, max_new_tokens=8, temperature=0.8, top_k=5, rng=11,
+    ))
+    server.drain()
+    a_info = dict(engine.last_prefill)
+    assert a_info["shared_blocks"] == 0 and a_info["start"] == 0
+    assert a_info["bucket"] == 16
+    # full blocks = 12 // 4 = 3, but sharing is capped at t-1 = 11
+    # tokens -> 2 shareable blocks
+    shared_ids = a_info["blocks"][:2]
+    before = _paged_k_blocks(engine, shared_ids)
+
+    hB = server.submit(Request(
+        prompt=prompt, max_new_tokens=8, temperature=0.8, top_k=5, rng=99,
+    ))
+    server.drain()
+    b_info = dict(engine.last_prefill)
+    assert b_info["shared_blocks"] == 2
+    assert b_info["start"] == 2 * BLOCK
+    assert b_info["bucket"] == 4                    # suffix-only prefill
+    assert b_info["blocks"][:2] == shared_ids       # same physical blocks
+
+    # prefilled exactly once: the second prefill did not rewrite them
+    after = _paged_k_blocks(engine, shared_ids)
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+
+    _assert_request_parity(hA, model, params)
+    _assert_request_parity(hB, model, params)
+    assert engine.allocator.stats["prefix_hit_requests"] >= 1
+
+
+def test_prefix_sharing_concurrent_co_resident(engine, model, params):
+    """Prefix reuse while the donor is STILL RUNNING: refcounts keep the
+    shared blocks alive and both streams stay bitwise."""
+    rng = np.random.RandomState(8)
+    prompt = _prompt(rng, 8)
+    server = Server(engine, prefills_per_step=1)
+    hA = server.submit(Request(prompt=prompt, max_new_tokens=10, rng=1))
+    hB = server.submit(Request(prompt=prompt, max_new_tokens=10, rng=2))
+    server.step()   # admits A (full prefill, registers blocks)
+    server.step()   # admits B -> shares A's live blocks
+    assert engine.last_prefill["shared_blocks"] == 1  # cap 7 tokens -> 1
+    shared = engine.last_prefill["blocks"][0]
+    assert engine.allocator.refcount(shared) == 2
+    server.drain()
+    _assert_request_parity(hA, model, params)
+    _assert_request_parity(hB, model, params)
+    assert engine.allocator.live_count == 0
+
+
+def test_prefix_cache_off_never_shares(model, params):
+    eng = SlotEngine(
+        model, params, num_slots=2, max_len=MAX_LEN, buckets=(8,),
+        kv_layout="paged", block_size=BLOCK, prefix_cache=False,
+    )
+    eng.warmup()
+    prompt = np.arange(8, dtype=np.int32) % VOCAB
+    server = Server(eng)
+    server.submit(Request(prompt=prompt, max_new_tokens=4))
+    server.drain()
+    server.submit(Request(prompt=prompt, max_new_tokens=4))
+    server.drain()
+    assert eng.last_prefill["shared_blocks"] == 0
+    assert eng.allocator.stats["prefix_hit_blocks"] == 0
+
+
+# -- backpressure / admission gating ---------------------------------------
+
+
+def test_block_exhaustion_backpressure(model, params):
+    """A pool sized for ~2 co-resident requests holds the third at the
+    queue head (no admission, no error), a full queue raises QueueFull
+    at submit, and everything still completes bitwise once blocks free
+    up."""
+    eng = SlotEngine(
+        model, params, num_slots=4, max_len=MAX_LEN, buckets=(8,),
+        kv_layout="paged", block_size=BLOCK, num_blocks=9,  # 8 usable
+        prefix_cache=False,
+    )
+    eng.warmup()
+    server = Server(eng, queue_depth=2)
+    rng = np.random.RandomState(3)
+    # each request needs ceil((8 + 8 - 1)/4) = 4 blocks -> 2 fit
+    mk = lambda: Request(  # noqa: E731
+        prompt=_prompt(rng, 8), max_new_tokens=8
+    )
+    running = [server.submit(mk()), server.submit(mk())]
+    server.step()
+    server.step()
+    assert len(server._by_slot) == 2            # both admitted
+    assert eng.allocator.free_count == 0
+    queued = [server.submit(mk()), server.submit(mk())]
+    server.step()
+    assert queued[0].status == "queued"         # blocked on blocks,
+    assert len(server._by_slot) == 2            # not on slots
+    with pytest.raises(QueueFull):
+        server.submit(mk())                     # backpressure surfaces
+    assert server.stats["rejected"] == 1
+    server.drain()
+    for h in running + queued:
+        assert h.status == "done"
+        _assert_request_parity(h, model, params)
+    assert eng.allocator.live_count == 0
+
+
+def test_paged_validation_rejects_oversized_request(model, params):
+    eng = SlotEngine(
+        model, params, num_slots=2, max_len=MAX_LEN, buckets=BUCKETS,
+        kv_layout="paged", block_size=BLOCK, num_blocks=4,  # 3 usable
+    )
+    with pytest.raises(ValueError, match="KV blocks"):
+        # needs ceil((16+10-1)/4) = 7 blocks > 3
+        eng.validate_spec(ReqSpec(np.zeros(16, np.int32), 10))
+    # a fitting request validates
+    eng.validate_spec(ReqSpec(np.zeros(8, np.int32), 4))
+
+
+def test_paged_serve_config_from_env():
+    cfg = ServeConfig.from_env({
+        "SERVE_KV_LAYOUT": "paged", "SERVE_BLOCK_SIZE": "8",
+        "SERVE_NUM_BLOCKS": "33", "SERVE_PREFIX_CACHE": "0",
+        "SERVE_SLOTS": "4",
+    })
+    assert cfg.kv_layout == "paged"
+    assert cfg.block_size == 8 and cfg.num_blocks == 33
+    assert cfg.prefix_cache is False
+    kw = cfg.engine_kwargs()
+    assert kw["kv_layout"] == "paged" and kw["num_blocks"] == 33
+    dflt = ServeConfig.from_env({})
+    assert dflt.kv_layout == "dense" and dflt.prefix_cache is True
+    assert "block_size" not in dflt.engine_kwargs()
+
+
+def test_paged_server_build_from_config(model, params):
+    server = Server.build(model, params, ServeConfig(
+        num_slots=2, buckets=(8,), kv_layout="paged", block_size=8,
+    ))
+    assert server.engine.kv_layout == "paged"
+    assert server.engine.block_size == 8
+    assert server.engine.allocator is not None
+    # dense-equivalent default pool: slots * ceil(max_len/bs) + trash
+    assert server.engine.num_blocks == 2 * (MAX_LEN // 8) + 1
+
+
+# -- obs plumbing ----------------------------------------------------------
+
+
+def test_paged_obs_gauges_and_report(engine, tmp_path):
+    """Block-pool gauges land on the bus and the report's serving view
+    renders the pool-utilization line."""
+    from distributeddeeplearning_tpu import obs
+    from distributeddeeplearning_tpu.obs.report import (
+        load, render, summarize,
+    )
+
+    bus = obs.configure(str(tmp_path), run_id="serve-paged-test", proc=0,
+                        install_handlers=False)
+    try:
+        server = Server(engine)
+        rng = np.random.RandomState(9)
+        prompt = _prompt(rng, 8)
+        hs = [
+            server.submit(Request(prompt=prompt, max_new_tokens=4))
+            for _ in range(2)
+        ]
+        server.drain()
+        assert all(h.status == "done" for h in hs)
+        bus.flush()
+    finally:
+        obs.reset()
+    summary = summarize(load([str(tmp_path)]))
+    srv = summary["serving"]
+    assert srv is not None
+    assert srv["block_pool_total"] == float(engine.allocator.capacity)
+    assert srv["block_pool_free"] is not None
+    assert srv["prefix_hits"] and srv["prefix_hits"] > 0
+    text = render(summary)
+    assert "block pool" in text
+    assert "prefix hits" in text
