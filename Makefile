@@ -23,8 +23,8 @@ PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        accum-memory fault-suite serve-bench native provision setup submit \
-        stream status stop teardown
+        obs-watch bench-trend accum-memory fault-suite serve-bench native \
+        provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
 build:
@@ -106,6 +106,14 @@ fault-suite:	## fast fault-injection battery: plan grammar, supervisor e2e,
 # launcher's --obs-dir, bench --events, or OBS_DIR on any entry point).
 obs-report:	## event-bus run report for the newest runs/<dir> (docs/OBSERVABILITY.md)
 	$(PY) scripts/obs_report.py $(or $(OBS_RUN),$(shell ls -td runs/*/ 2>/dev/null | head -1))
+
+obs-watch:	## live dashboard for the newest runs/<dir>: rollups + SLO burn
+	## rates, publishes rollup.json (OBS_RUN=dir, SLO_SPEC honored)
+	$(PY) scripts/obs_watch.py $(or $(OBS_RUN),$(shell ls -td runs/*/ 2>/dev/null | head -1))
+
+bench-trend:	## regression sentinel over BENCH_r*.json: fails on a >10%
+	## like-for-like drop; cpu/outage-tier rounds listed, never compared
+	$(PY) scripts/bench_trend.py
 
 ## Native IO tier (built on demand by the Python bindings too)
 native:

@@ -195,7 +195,13 @@ def launch_local(
     die without any process *exiting* — one rank stuck in a collective
     the others already left never returns and never prints. If NO child
     produces a line of output for ``hang_timeout`` seconds, the world is
-    declared hung and terminated (exit 125).
+    declared hung and terminated (exit 125). With ``obs_dir`` set the
+    watchdog also consumes liveness from the telemetry plane: growth of
+    any ``events-*``/``flight-*`` file (the bus flushes at least every
+    ``OBS_FLUSH_EVERY_S`` while a process emits — obs/bus.py) ticks the
+    heartbeat, so a world that works silently — no stdout, telemetry
+    flowing — is alive, and a *stale* event file is part of what "hung"
+    means.
 
     ``obs_dir``: the world's observability run directory. The launcher
     writes its own lifecycle events (rendezvous, child start/exit,
@@ -272,6 +278,16 @@ def launch_local(
     deadline = time.monotonic() + timeout if timeout else None
     exit_code = 0
     live = set(range(num_processes))
+    # Telemetry liveness (obs/tail.py): a changed (name, size) signature
+    # over the run dir's event files means some process appended
+    # telemetry — tick the heartbeat like stdout would. stat()-only and
+    # throttled to ~1 Hz so the 10 Hz supervision loop stays cheap.
+    obs_sig = None
+    obs_sig_next = 0.0
+    if obs_dir and hang_timeout:
+        from distributeddeeplearning_tpu.obs.tail import activity_signature
+
+        obs_sig = activity_signature(obs_dir)
     try:
         while live:
             for pid in sorted(live):
@@ -293,6 +309,12 @@ def launch_local(
                 if lbus is not None:
                     lbus.point("timeout_fired", timeout_s=timeout)
                 raise _ChildFailed()
+            if obs_sig is not None and time.monotonic() >= obs_sig_next:
+                obs_sig_next = time.monotonic() + 1.0
+                sig = activity_signature(obs_dir)
+                if sig != obs_sig:
+                    obs_sig = sig
+                    heartbeat[0] = time.monotonic()
             if (
                 hang_timeout
                 and time.monotonic() - heartbeat[0] > hang_timeout

@@ -1,10 +1,16 @@
-"""Structured observability: event bus, flight recorder, trace capture.
+"""Structured observability: event bus, flight recorder, live plane.
 
 The repo-wide rule: layers emit *through* the bus, not around it. The
 training loop, warmup, checkpointing, host-sync accounting, launcher
 and job submitter all record spans/counters/gauges here; ``OBS_DIR``
 turns on per-process JSONL capture, the flight-recorder ring is always
-armed, and ``scripts/obs_report.py`` renders a merged run report. See
+armed, and ``scripts/obs_report.py`` renders a merged run report.
+
+The **live plane** reads the same files while the run is alive:
+``obs/tail.py`` (incremental multi-file tailer), ``obs/rollup.py``
+(windowed rollups + atomic ``rollup.json`` snapshots), ``obs/slo.py``
+(``SLO_SPEC`` objectives with multi-window burn rates, emitting
+``slo_breach``/``slo_recover`` back into the bus). See
 ``docs/OBSERVABILITY.md`` for the schema and knobs.
 """
 
@@ -23,10 +29,25 @@ from distributeddeeplearning_tpu.obs.bus import (
     span,
     span_event,
 )
+from distributeddeeplearning_tpu.obs.rollup import (  # noqa: F401
+    LivePlane,
+    WindowedAggregator,
+    read_snapshot,
+    write_snapshot,
+)
+from distributeddeeplearning_tpu.obs.slo import (  # noqa: F401
+    SloEngine,
+    parse_slo_spec,
+)
+from distributeddeeplearning_tpu.obs.tail import Tailer  # noqa: F401
 
 __all__ = [
     "DEFAULT_RING_SIZE",
     "EventBus",
+    "LivePlane",
+    "SloEngine",
+    "Tailer",
+    "WindowedAggregator",
     "configure",
     "configure_from_env",
     "counter",
@@ -34,8 +55,11 @@ __all__ = [
     "gauge",
     "get_bus",
     "install_crash_handlers",
+    "parse_slo_spec",
     "point",
+    "read_snapshot",
     "reset",
     "span",
     "span_event",
+    "write_snapshot",
 ]

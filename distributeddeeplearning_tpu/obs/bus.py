@@ -19,8 +19,17 @@ substrate under all of them:
 * **Sync-free by construction** — emitting buffers a plain dict
   host-side; nothing here may ever touch a jax array or the device.
   The hot loop's instrumentation cost is a dict append; file writes
-  happen at epoch boundaries (``flush()``) or on the internal
-  batch-size threshold, never per event.
+  happen on the time threshold below, at epoch boundaries (``flush()``)
+  or on the internal batch-size threshold, never per event.
+* **Bounded staleness** — the live telemetry plane (``obs/tail.py``)
+  and the launcher's watchdog read these files *while the run is
+  alive*; a bus that only flushed at epoch boundaries would show them
+  a file minutes stale. ``OBS_FLUSH_EVERY_S`` (default 5s) flushes the
+  buffer whenever an emit lands at least that long after the previous
+  flush — still batched writes (never per-event I/O in a tight loop),
+  still zero host syncs, but a reader's view lags live events by at
+  most the knob. ``OBS_FLUSH_EVERY_S=0`` restores the old
+  epoch-boundary-only behavior.
 
 Schema (one JSON object per line)::
 
@@ -34,7 +43,9 @@ Schema (one JSON object per line)::
 
 Knobs (env): ``OBS_DIR`` (run directory; unset = ring-only, no files),
 ``OBS_RUN_ID`` (shared by the launcher so all processes of one world
-agree), ``OBS_RING_SIZE`` (flight-recorder depth, default 512).
+agree), ``OBS_RING_SIZE`` (flight-recorder depth, default 512),
+``OBS_FLUSH_EVERY_S`` (max buffered-event staleness, default 5s; 0 =
+flush only on the size threshold / explicit ``flush()``).
 """
 
 from __future__ import annotations
@@ -54,6 +65,19 @@ from typing import Any, Dict, Iterator, Optional, Union
 SCHEMA_VERSION = 1
 DEFAULT_RING_SIZE = 512
 _AUTOFLUSH_EVERY = 256
+DEFAULT_FLUSH_EVERY_S = 5.0
+
+
+def _flush_every_s_from_env() -> float:
+    try:
+        return max(
+            float(os.environ.get(
+                "OBS_FLUSH_EVERY_S", str(DEFAULT_FLUSH_EVERY_S)
+            )),
+            0.0,
+        )
+    except ValueError:
+        return DEFAULT_FLUSH_EVERY_S
 
 
 def _proc_tag(proc: Union[int, str]) -> str:
@@ -77,8 +101,14 @@ class EventBus:
         proc: Optional[Union[int, str]] = None,
         ring_size: int = DEFAULT_RING_SIZE,
         identity: Optional[Dict[str, Any]] = None,
+        flush_every_s: Optional[float] = None,
     ) -> None:
         self._lock = threading.Lock()
+        self._flush_every_s = (
+            _flush_every_s_from_env() if flush_every_s is None
+            else max(float(flush_every_s), 0.0)
+        )
+        self._last_flush = time.monotonic()
         if proc is None:
             proc = int(os.environ.get("DDL_PROCESS_ID", "0"))
             # Restart supervisor (launch.launch_supervised): attempt k>0
@@ -153,7 +183,16 @@ class EventBus:
             self.ring.append(rec)
             if self._fh is not None:
                 self._buffer.append(rec)
-                if len(self._buffer) >= _AUTOFLUSH_EVERY:
+                # Size threshold, OR the bounded-staleness clock: the
+                # first emit landing >= OBS_FLUSH_EVERY_S after the last
+                # flush carries the whole buffer out, so live readers
+                # (tailer, watchdog liveness) never see a file more than
+                # one knob-interval behind an *emitting* process.
+                if len(self._buffer) >= _AUTOFLUSH_EVERY or (
+                    self._flush_every_s > 0
+                    and time.monotonic() - self._last_flush
+                    >= self._flush_every_s
+                ):
                     self._flush_locked()
 
     def counter(self, name: str, n: int = 1, **labels: Any) -> None:
@@ -189,6 +228,7 @@ class EventBus:
     # -- persistence -------------------------------------------------------
 
     def _flush_locked(self) -> None:
+        self._last_flush = time.monotonic()
         if self._fh is None or not self._buffer:
             return
         self._fh.write(

@@ -150,6 +150,9 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
     gauges: Dict[str, float] = {}
     gauge_means: Dict[str, List[float]] = {}  # name -> [sum, count]
     points: Dict[str, int] = {}
+    # SLO engine transitions (obs/slo.py): per-objective breach/recover
+    # timeline + the worst burn rate observed at any transition.
+    slo_by_obj: Dict[str, Dict[str, Any]] = {}
     procs: Dict[Any, Dict[str, Any]] = {}
     # name -> epoch -> {proc: end_wall}; cross-process skew is read off
     # the per-epoch boundary (every process ends epoch k once).
@@ -191,6 +194,25 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
                 pass
         elif kind == "point":
             points[name] = points.get(name, 0) + 1
+            if name in ("slo_breach", "slo_recover"):
+                obj = labels.get("objective", "?")
+                entry = slo_by_obj.setdefault(
+                    obj,
+                    {"breaches": 0, "recovers": 0, "worst_burn": 0.0,
+                     "timeline": []},
+                )
+                kind_short = "breach" if name == "slo_breach" else "recover"
+                entry["breaches" if kind_short == "breach"
+                      else "recovers"] += 1
+                try:
+                    burn = float(labels.get("burn", 0.0))
+                except (TypeError, ValueError):
+                    burn = 0.0
+                entry["worst_burn"] = max(entry["worst_burn"], burn)
+                entry["timeline"].append({
+                    "wall": w, "event": kind_short, "burn": burn,
+                    "value": labels.get("value"),
+                })
 
     span_stats = {}
     for name, durs in spans.items():
@@ -253,6 +275,11 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
             "request": span_stats.get("serve.request"),
         }
 
+    for entry in slo_by_obj.values():
+        entry["timeline"].sort(
+            key=lambda e: (e["wall"] is None, e["wall"] or 0.0)
+        )
+
     run_ids = {m.get("run") for m in loaded["metas"].values()}
     return {
         "run_ids": sorted(r for r in run_ids if r),
@@ -266,6 +293,7 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         "compile_s": compile_s,
         "step_s": step_s,
         "serving": serving,
+        "slo": slo_by_obj or None,
         "max_epoch_skew_ms": max(skews) if skews else 0.0,
         "epochs_seen": len(epoch_ends),
     }
@@ -349,6 +377,36 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
                     f"  {label:14s} n={s['count']:<6d} "
                     f"total {s['total_s']:8.3f}s  p50 {s['p50_ms']:8.2f}ms  "
                     f"p99 {s['p99_ms']:8.2f}ms"
+                )
+    slo = summary.get("slo")
+    if slo:
+        add("")
+        add("SLO (breach/recover timeline, obs/slo.py):")
+        t0s = [
+            e["wall"] for s in slo.values() for e in s["timeline"]
+            if e["wall"] is not None
+        ]
+        slo_base = min(t0s) if t0s else 0.0
+        for obj, s in sorted(slo.items()):
+            state = (
+                "STILL BREACHED" if s["breaches"] > s["recovers"]
+                else "recovered"
+            )
+            add(
+                f"  {obj}: {s['breaches']} breach(es), worst burn "
+                f"{s['worst_burn']:.2f}x, {state}"
+            )
+            for e in s["timeline"]:
+                when = (
+                    f"+{e['wall'] - slo_base:8.3f}s"
+                    if e["wall"] is not None else "<no wall>"
+                )
+                add(
+                    f"    {when}  {e['event']:7s}  burn {e['burn']:.2f}x"
+                    + (
+                        f"  value {e['value']}"
+                        if e.get("value") is not None else ""
+                    )
                 )
     if summary["epochs_seen"]:
         add(f"epochs: {summary['epochs_seen']}, max cross-process "

@@ -16,7 +16,11 @@ accelerator between requests and recompile per prompt length. Here:
   assigned slot's cache rows.
 * :class:`~.scheduler.Server` — bounded admission queue with
   backpressure, FIFO + prefill/decode interleave, per-request
-  deadline/cancel, graceful drain, instrumentation through the obs bus.
+  deadline/cancel, graceful drain, instrumentation through the obs bus,
+  and a pluggable :class:`~.scheduler.AdmissionPolicy`:
+  :class:`~.scheduler.AdaptiveAdmissionPolicy` closes the telemetry
+  loop — it reads the live plane's rollup snapshot and derates
+  admission while a latency SLO burns (docs/SERVING.md).
 
 Per-request output is **bitwise-identical** to sequential
 ``inference.generate`` (greedy and seeded sampling) whatever the
@@ -40,6 +44,8 @@ from distributeddeeplearning_tpu.serving.sampling import (  # noqa: F401
     sample_slots,
 )
 from distributeddeeplearning_tpu.serving.scheduler import (  # noqa: F401
+    AdaptiveAdmissionPolicy,
+    AdmissionPolicy,
     QueueFull,
     Request,
     RequestHandle,

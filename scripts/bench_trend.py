@@ -1,0 +1,194 @@
+"""Regression sentinel over the bench trajectory (``BENCH_r*.json``).
+
+Every round the driver re-runs ``bench.py`` and archives the record as
+``BENCH_r<k>.json``. This script reads that trajectory and answers the
+one question a perf-focused repo must keep answering: **did a
+like-for-like headline regress?** — while refusing to be fooled by
+infra outages. Rounds 4–5 taught the lesson: a dead accelerator relay
+used to emit ``value: 0.0``, which a naive diff reads as a 100%
+regression. Records now carry a ``tier`` (``bench.py``): ``"cpu"`` =
+relay down, protocol re-run on the CPU fallback; ``"outage"`` = nothing
+could run. Neither is comparable to a TPU round, so both are **listed
+but skipped** — as are legacy outage records (``error`` / value ≤ 0
+with no tier) and cross-platform pairs.
+
+A drop > ``--threshold`` (default 10%) between *consecutive comparable*
+records of the same metric+platform exits nonzero — the CI tripwire
+``make bench-trend`` wires up.
+
+Usage::
+
+    python scripts/bench_trend.py [--glob 'BENCH_r*.json']
+        [--threshold 0.10] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_round(path: str) -> Dict[str, Any]:
+    """One trajectory entry: round number + the parsed bench record
+    (may be absent when the round's output was unparseable)."""
+    with open(path) as fh:
+        d = json.load(fh)
+    m = _ROUND_RE.search(os.path.basename(path))
+    n = d.get("n") if isinstance(d, dict) else None
+    if n is None and m:
+        n = int(m.group(1))
+    record = d.get("parsed") if isinstance(d, dict) else None
+    return {
+        "path": path,
+        "round": n,
+        "rc": d.get("rc") if isinstance(d, dict) else None,
+        "record": record if isinstance(record, dict) else None,
+    }
+
+
+def classify(entry: Dict[str, Any]) -> Optional[str]:
+    """Why this round is NOT comparable (None = comparable).
+
+    ``tier: cpu/outage`` records are deliberate infra annotations;
+    legacy outage rounds (pre-tier) show up as an error field or a
+    non-positive value. Reporting any of them as a regression would be
+    exactly the 100%-drop misread this sentinel exists to kill."""
+    rec = entry["record"]
+    if rec is None:
+        return "unparsed"
+    tier = rec.get("tier") or (rec.get("detail") or {}).get("tier")
+    if tier in ("cpu", "outage"):
+        return f"tier:{tier}"
+    if rec.get("error"):
+        return "error"
+    try:
+        if float(rec.get("value", 0.0)) <= 0.0:
+            return "zero_value"
+    except (TypeError, ValueError):
+        return "bad_value"
+    return None
+
+
+def analyze(
+    paths: List[str], threshold: float = 0.10
+) -> Dict[str, Any]:
+    """The trajectory verdict: per-round rows + like-for-like drops."""
+    entries = sorted(
+        (load_round(p) for p in paths),
+        key=lambda e: (e["round"] is None, e["round"] or 0),
+    )
+    rows: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    # metric -> last comparable (round, value, platform)
+    last: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        rec = e["record"] or {}
+        skip = classify(e)
+        row = {
+            "round": e["round"],
+            "metric": rec.get("metric"),
+            "value": rec.get("value"),
+            "unit": rec.get("unit"),
+            "platform": (rec.get("detail") or {}).get("platform"),
+            "skip": skip,
+            "delta_pct": None,
+        }
+        if skip is None:
+            metric = rec["metric"]
+            value = float(rec["value"])
+            prev = last.get(metric)
+            if prev is not None and prev["platform"] == row["platform"]:
+                delta = (value - prev["value"]) / prev["value"]
+                row["delta_pct"] = round(delta * 100.0, 2)
+                if delta < -threshold:
+                    regressions.append({
+                        "metric": metric,
+                        "from_round": prev["round"],
+                        "to_round": e["round"],
+                        "from_value": prev["value"],
+                        "to_value": value,
+                        "drop_pct": round(-delta * 100.0, 2),
+                    })
+            elif prev is not None:
+                row["skip"] = (
+                    f"platform_change:{prev['platform']}->{row['platform']}"
+                )
+            if row["skip"] is None:
+                last[metric] = {
+                    "round": e["round"], "value": value,
+                    "platform": row["platform"],
+                }
+        rows.append(row)
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "threshold_pct": threshold * 100.0,
+        "ok": not regressions,
+    }
+
+
+def render(result: Dict[str, Any]) -> str:
+    out = []
+    add = out.append
+    add(f"{'round':>5s} {'metric':42s} {'value':>12s} {'Δ%':>8s}  note")
+    for r in result["rows"]:
+        val = "-" if r["value"] is None else f"{r['value']:.1f}"
+        delta = "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}"
+        note = r["skip"] or (r["platform"] or "")
+        add(
+            f"{r['round'] if r['round'] is not None else '?':>5} "
+            f"{(r['metric'] or '<unparsed>'):42s} {val:>12s} {delta:>8s}"
+            f"  {note}"
+        )
+    if result["regressions"]:
+        add("")
+        for g in result["regressions"]:
+            add(
+                f"REGRESSION: {g['metric']} dropped {g['drop_pct']:.1f}% "
+                f"(round {g['from_round']}: {g['from_value']:.1f} -> "
+                f"round {g['to_round']}: {g['to_value']:.1f}; "
+                f"threshold {result['threshold_pct']:.0f}%)"
+            )
+    else:
+        add("")
+        add(
+            f"OK: no like-for-like drop > {result['threshold_pct']:.0f}% "
+            f"(outage/cpu-tier rounds skipped, not misread)"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--glob", default=os.path.join(REPO, "BENCH_r*.json"),
+        help="trajectory files (default: repo-root BENCH_r*.json)",
+    )
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="like-for-like drop that fails (fraction)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    paths = sorted(globlib.glob(args.glob))
+    if not paths:
+        print(f"ERROR: no trajectory files match {args.glob}",
+              file=sys.stderr)
+        return 2
+    result = analyze(paths, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(render(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""Live telemetry dashboard — watch a running world's rollups + SLOs.
+
+The read loop of the live plane (docs/OBSERVABILITY.md): tail the run
+directory's ``events-*.jsonl`` part files incrementally, aggregate them
+into rolling-window rollups (counter rates, last-value gauges, span
+p50/p95/p99 from fixed-bucket log histograms), evaluate the ``SLO_SPEC``
+objectives with multi-window burn rates, publish the snapshot as an
+atomically-replaced ``rollup.json`` (the file an adaptive admission
+policy reads — docs/SERVING.md), and render it to the terminal.
+
+Deliberately jax-free: it must run against a live TPU world from any
+machine that can see the run directory, including one with no
+accelerator stack at all.
+
+Usage::
+
+    python scripts/obs_watch.py [RUN_DIR] [--once] [--json]
+        [--interval S] [--window S] [--slo SPEC_OR_FILE] [--no-write]
+    make obs-watch                    # newest runs/<dir>, live
+
+``--once`` renders a single snapshot and exits (scriptable / CI);
+without it the dashboard refreshes every ``--interval`` seconds until
+interrupted. ``--slo`` overrides the ``SLO_SPEC`` env (inline spec or a
+spec file path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def newest_run_dir(base: str = "runs") -> str:
+    """The most recently modified run directory under ``runs/`` — the
+    Makefile's obs-report convention."""
+    dirs = [d for d in glob.glob(os.path.join(base, "*")) if os.path.isdir(d)]
+    if not dirs:
+        raise SystemExit(
+            f"ERROR: no run directories under {base}/ — pass one "
+            f"(launch.py --obs-dir, bench --events, or OBS_DIR)"
+        )
+    return max(dirs, key=os.path.getmtime)
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(snapshot: dict) -> str:
+    """Human-readable dashboard frame from one rollup snapshot."""
+    out = []
+    add = out.append
+    win = snapshot.get("window_s")
+    add(
+        f"run dir: {snapshot.get('run_dir', '?')}   "
+        f"files: {snapshot.get('files', '?')}   "
+        f"events: {snapshot.get('events_total', 0)}   "
+        f"window: {win:g}s"
+    )
+    slo = snapshot.get("slo")
+    if slo is not None:
+        add("")
+        add("SLO objectives (burn = value/threshold; >1 = failing):")
+        for st in slo:
+            flag = "BURNING" if st["burning"] else (
+                "ok" if st["burn"] <= 1.0 else "hot"
+            )
+            value = st.get("value")
+            add(
+                f"  [{flag:7s}] {st['objective']:44s} "
+                f"burn {st['burn']:8.2f}x (long {st['burn_long']:.2f}x)  "
+                f"value {_fmt_val(value) if value is not None else 'n/a':>10s}"
+                f"  worst {st['worst_burn']:.2f}x  "
+                f"breaches {st['breaches']}"
+            )
+    spans = snapshot.get("spans") or {}
+    if spans:
+        add("")
+        add(f"{'span (window)':32s} {'count':>7s} {'p50 ms':>9s} "
+            f"{'p95 ms':>9s} {'p99 ms':>9s} {'max ms':>9s}")
+        for name, s in sorted(
+            spans.items(), key=lambda kv: -kv[1]["count"]
+        ):
+            add(
+                f"{name:32s} {s['count']:7d} {s['p50_ms']:9.3f} "
+                f"{s['p95_ms']:9.3f} {s['p99_ms']:9.3f} {s['max_ms']:9.3f}"
+            )
+    counters = snapshot.get("counters") or {}
+    if counters:
+        add("")
+        add(f"{'counter (window)':32s} {'sum':>10s} {'rate/s':>10s}")
+        for name, c in sorted(counters.items()):
+            add(f"{name:32s} {c['sum']:10.0f} {c['rate_per_s']:10.3f}")
+    gauges = snapshot.get("gauges") or {}
+    if gauges:
+        add("")
+        add(f"{'gauge (last value)':32s} {'value':>12s} {'age s':>8s}")
+        for name, g in sorted(gauges.items()):
+            age = g.get("age_s")
+            add(
+                f"{name:32s} {_fmt_val(g['value']):>12s} "
+                f"{age if age is not None else '?':>8}"
+            )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "path", nargs="?", default=None,
+        help="run directory (default: newest runs/<dir>)",
+    )
+    p.add_argument("--once", action="store_true",
+                   help="render one snapshot and exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit the snapshot as JSON (implies --once)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in live mode (s)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="rollup window (s)")
+    p.add_argument(
+        "--slo", default=None,
+        help="SLO spec (inline or file path; default: $SLO_SPEC)",
+    )
+    p.add_argument(
+        "--no-write", action="store_true",
+        help="don't publish rollup.json (read-only observer)",
+    )
+    args = p.parse_args(argv)
+
+    from distributeddeeplearning_tpu.obs.rollup import LivePlane
+    from distributeddeeplearning_tpu.obs.slo import SloEngine
+
+    directory = args.path or newest_run_dir()
+    if not os.path.isdir(directory):
+        print(f"ERROR: {directory} is not a run directory", file=sys.stderr)
+        return 2
+    env = dict(os.environ)
+    if args.slo is not None:
+        env["SLO_SPEC"] = args.slo
+    slo = SloEngine.from_env(env)
+    plane = LivePlane(directory, window_s=args.window, slo_engine=slo)
+    write = not args.no_write
+
+    if args.once or args.json:
+        snap = plane.poll(now=time.time(), write=write)
+        if args.json:
+            print(json.dumps(snap, default=str))
+        else:
+            print(render(snap))
+        return 0
+    try:
+        while True:
+            snap = plane.poll(now=time.time(), write=write)
+            # ANSI home+clear: one flicker-free frame per interval.
+            sys.stdout.write("\x1b[H\x1b[2J")
+            print(time.strftime("%H:%M:%S"), "obs-watch", directory)
+            print(render(snap))
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

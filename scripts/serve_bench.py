@@ -34,7 +34,12 @@ all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail),
 ``SERVE_POOL_SLOT_BUDGET`` (4 — the fixed byte budget, in dense slots),
 ``BENCH_MODEL`` (lm_tiny), ``BENCH_VOCAB`` (32000), plus the generic
 ``OBS_DIR``/``--events`` and ``COMPILATION_CACHE_DIR`` plumbing
-bench.py uses.
+bench.py uses. With ``SLO_SPEC`` set (and ``OBS_DIR``) the bench runs
+under the live telemetry plane — rollups + SLO burn rates published to
+``<OBS_DIR>/rollup.json`` while serving — and
+``SERVE_ADMISSION_POLICY=adaptive`` closes the feedback loop: the
+scheduler derates admission while a latency SLO burns
+(docs/SERVING.md, docs/OBSERVABILITY.md).
 
 Usage::
 
@@ -162,7 +167,8 @@ def run_continuous(server, reqs, temperature, top_k):
 
 
 def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
-                     queue_depth, prefills_per_step, temperature, top_k):
+                     queue_depth, prefills_per_step, temperature, top_k,
+                     admission_policy=None):
     """Build + warm one engine, replay the request schedule through it,
     and report throughput, concurrency, latency percentiles, parity
     against the sequential outputs and the compile ledger."""
@@ -175,6 +181,7 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
     server = Server(
         engine, queue_depth=max(queue_depth, len(reqs)),
         prefills_per_step=prefills_per_step,
+        admission_policy=admission_policy,
     )
     # Warm pass: one request end-to-end so first-dispatch overheads
     # (host transfers, executable load) stay out of the measurement.
@@ -227,6 +234,34 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
     return out
 
 
+def start_live_plane(obs_dir):
+    """Run the live telemetry plane (tail -> rollup -> SLO -> rollup.json)
+    in a background thread for the duration of the bench — the thing an
+    adaptive admission policy (SERVE_ADMISSION_POLICY=adaptive) reads.
+    Returns (stop_event, thread), or (None, None) when SLO_SPEC is
+    unset (no objectives = nothing to evaluate or feed back)."""
+    import threading
+
+    from distributeddeeplearning_tpu.obs.rollup import LivePlane
+    from distributeddeeplearning_tpu.obs.slo import SloEngine
+
+    slo = SloEngine.from_env()
+    if slo is None:
+        return None, None
+    plane = LivePlane(obs_dir, slo_engine=slo)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            plane.poll(now=time.time())
+            stop.wait(0.2)
+        plane.poll(now=time.time())
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return stop, t
+
+
 def main() -> int:
     if "--events" in sys.argv[1:] or os.environ.get("OBS_DIR"):
         from distributeddeeplearning_tpu import obs
@@ -236,6 +271,13 @@ def main() -> int:
                 "runs", f"serve-bench-{int(time.time())}"
             )
         obs.configure_from_env()
+    # Live plane (docs/OBSERVABILITY.md): with SLO_SPEC set the bench
+    # runs under its own telemetry — rollup.json is published next to
+    # the event files and SERVE_ADMISSION_POLICY=adaptive closes the
+    # loop (shed-then-recover under a burning latency SLO).
+    plane_stop = plane_thread = None
+    if os.environ.get("OBS_DIR") and os.environ.get("SLO_SPEC"):
+        plane_stop, plane_thread = start_live_plane(os.environ["OBS_DIR"])
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -321,6 +363,7 @@ def main() -> int:
                 queue_depth=cfg.queue_depth,
                 prefills_per_step=cfg.prefills_per_step,
                 temperature=temperature, top_k=top_k,
+                admission_policy=cfg.build_admission_policy(),
             )
         if layout in ("paged", "compare"):
             runs["paged"] = serve_one_engine(
@@ -329,6 +372,7 @@ def main() -> int:
                 queue_depth=cfg.queue_depth,
                 prefills_per_step=cfg.prefills_per_step,
                 temperature=temperature, top_k=top_k,
+                admission_policy=cfg.build_admission_policy(),
             )
 
         detail = {
@@ -398,6 +442,10 @@ def main() -> int:
             "unit": "tokens/sec", "vs_baseline": 0.0, "error": repr(e),
         })
         raise
+    finally:
+        if plane_stop is not None:
+            plane_stop.set()
+            plane_thread.join(timeout=10)
 
 
 if __name__ == "__main__":

@@ -305,6 +305,43 @@ def test_obs_run_produces_merged_events_and_report(tmp_path):
     assert "work" in rep.stdout and "timeline" in rep.stdout
 
 
+def test_watchdog_accepts_telemetry_as_liveness(tmp_path):
+    """Live-plane liveness (ISSUE 7): a process that prints NOTHING but
+    keeps emitting bus events (flushed by OBS_FLUSH_EVERY_S) must not be
+    declared hung — the watchdog consumes event-file growth as a
+    heartbeat. The control case (same silence, no events) is
+    test_hang_watchdog_kills_silent_world."""
+    script = tmp_path / "silent_worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import time
+        from distributeddeeplearning_tpu import obs
+
+        bus = obs.configure_from_env()
+        for i in range(18):          # ~7.2s of stdout silence
+            bus.point("tick", i=i)
+            time.sleep(0.4)
+        bus.flush()
+        """
+    ))
+    obs_dir = tmp_path / "run-liveness"
+    res = _run_launcher(
+        [
+            "--num-processes", "1",
+            "--obs-dir", str(obs_dir),
+            "--hang-timeout", "6",   # > child import time, < its runtime
+            "--timeout", "120",
+            "--env", "JAX_PLATFORMS=cpu",
+            "--env", "OBS_FLUSH_EVERY_S=0.5",
+            str(script),
+        ],
+        timeout=180,
+    )
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    assert "declaring the world hung" not in out
+
+
 def test_obs_killed_child_leaves_flight_dump(tmp_path):
     """Watchdog kill (SIGTERM) = preemption rehearsal: the hung child's
     flight-recorder ring reaches disk with its last events — including
