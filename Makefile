@@ -82,11 +82,13 @@ bench:
 recertify:	## all headline protocols at one HEAD -> RECERT.json (round 5)
 	$(PY) scripts/recertify.py
 
-decode-audit:	## decode-tier roofline + batch sweep (round 5)
+decode-audit:	## decode-tier roofline + batch sweep (round 5; --kv-dtype/
+	## --weight-dtype int8 audit the quantized floor, scales itemized)
 	$(PY) scripts/decode_audit.py
 
 serve-bench:	## continuous batching vs sequential generate under Poisson
-	## load (docs/SERVING.md protocol; SERVE_*/BENCH_VOCAB knobs)
+	## load (docs/SERVING.md protocol; SERVE_*/BENCH_VOCAB knobs;
+	## SERVE_KV_DTYPE/SERVE_WEIGHT_DTYPE=int8 run the quant compare)
 	$(PY) scripts/serve_bench.py
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)

@@ -45,7 +45,8 @@ from jax import lax
 PyTree = object
 
 
-def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0):
+def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0,
+                   kv_dtype: str = ""):
     """The model re-staged for KV-cache decoding (shared contract of
     this module and ``serving.SlotEngine``): mutable-cache attention,
     plain XLA einsum (decode is bandwidth-bound; Pallas/ring paths are
@@ -54,13 +55,18 @@ def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0):
     ``paged_blocks > 0`` selects the paged cache layout (one
     ``[paged_blocks, paged_block_size, H, Dh]`` pool per layer addressed
     through per-row block tables — the serving engine's
-    ``kv_layout="paged"``); the sequential path here always decodes
-    dense, so the kwargs are only passed through when set (custom models
-    without the fields keep working)."""
+    ``kv_layout="paged"``). ``kv_dtype="int8"`` stores the cache (dense
+    rows or block pool alike) as symmetric int8 + per-head f32 scales
+    (``ops/quant.py`` — the engine's ``SERVE_KV_DTYPE``). The sequential
+    path here always decodes dense/unquantized, so the kwargs are only
+    passed through when set (custom models without the fields keep
+    working)."""
     kw = {}
     if paged_blocks:
-        kw = dict(paged_blocks=int(paged_blocks),
+        kw.update(paged_blocks=int(paged_blocks),
                   paged_block_size=int(paged_block_size))
+    if kv_dtype and kv_dtype != "bf16":
+        kw.update(kv_dtype=str(kv_dtype))
     return model.clone(decode=True, attn_impl="xla", seq_axis=None, **kw)
 
 

@@ -208,6 +208,9 @@ class MoEDecoderBlock(nn.Module):
     # Paged KV cache (serving tier; see models/vit.Attention): 0 = dense.
     paged_blocks: int = 0
     paged_block_size: int = 0
+    # KV-cache storage dtype ("" = compute dtype, "int8" = quantized
+    # cache + f32 scales; models/vit.Attention, SERVE_KV_DTYPE).
+    kv_dtype: str = ""
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -224,6 +227,7 @@ class MoEDecoderBlock(nn.Module):
             decode=self.decode,
             paged_blocks=self.paged_blocks,
             paged_block_size=self.paged_block_size,
+            kv_dtype=self.kv_dtype,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)

@@ -50,6 +50,9 @@ class DecoderBlock(nn.Module):
     # Paged KV cache (serving tier; see models/vit.Attention): 0 = dense.
     paged_blocks: int = 0
     paged_block_size: int = 0
+    # KV-cache storage dtype ("" = compute dtype, "int8" = quantized
+    # cache + f32 scales; models/vit.Attention, SERVE_KV_DTYPE).
+    kv_dtype: str = ""
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -64,6 +67,7 @@ class DecoderBlock(nn.Module):
             decode=self.decode,
             paged_blocks=self.paged_blocks,
             paged_block_size=self.paged_block_size,
+            kv_dtype=self.kv_dtype,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -107,6 +111,11 @@ class TransformerLM(nn.Module):
     # ``_paged_decode_attention``). 0 = dense per-row cache.
     paged_blocks: int = 0
     paged_block_size: int = 0
+    # Quantized KV cache (serving.SlotEngine kv_dtype="int8" /
+    # SERVE_KV_DTYPE): decode caches store symmetric int8 K/V + one f32
+    # scale per head per position; the gather dequantizes before the
+    # masked-score math (ops/quant.py). "" = store the compute dtype.
+    kv_dtype: str = ""
     # Gradient checkpointing (rematerialization): recompute each block's
     # activations during backward instead of storing them — trades ~1
     # extra forward of FLOPs for O(depth) activation memory. REMAT=1.
@@ -213,6 +222,7 @@ class TransformerLM(nn.Module):
                     decode=self.decode,
                     paged_blocks=self.paged_blocks,
                     paged_block_size=self.paged_block_size,
+                    kv_dtype=self.kv_dtype,
                     name=f"block{i}",
                 )(x, train)
             else:
@@ -226,6 +236,7 @@ class TransformerLM(nn.Module):
                     decode=self.decode,
                     paged_blocks=self.paged_blocks,
                     paged_block_size=self.paged_block_size,
+                    kv_dtype=self.kv_dtype,
                     name=f"block{i}",
                 )(x, train)
 

@@ -147,6 +147,23 @@ class Attention(nn.Module):
     # masks keep it unread. Requires per-row (vector) cache positions.
     paged_blocks: int = 0
     paged_block_size: int = 0
+    # KV-cache storage dtype (serving tier, SERVE_KV_DTYPE): "" keeps
+    # the compute dtype; "int8" stores symmetric int8 K/V plus one f32
+    # scale per head per position (ops/quant.py) — writes quantize, the
+    # decode gather dequantizes to the compute dtype before the shared
+    # masked-score tail. Halves the per-step KV bytes decode streams
+    # (scale overhead 4/Dh per element, itemized by decode_audit).
+    kv_dtype: str = ""
+
+    def _kv_quantized(self) -> bool:
+        if self.kv_dtype in ("", "bf16"):
+            return False
+        if self.kv_dtype != "int8":
+            raise ValueError(
+                f"kv_dtype must be '', 'bf16' or 'int8', got "
+                f"{self.kv_dtype!r}"
+            )
+        return True
 
     def _paged_decode_attention(self, q, k, v, ci):
         """Block-table-indexed variant of the decode cache: same math
@@ -157,13 +174,27 @@ class Attention(nn.Module):
         nb, bs = self.paged_blocks, self.paged_block_size
         b, t = q.shape[0], q.shape[1]
         heads, dh = k.shape[-2], k.shape[-1]
+        quant = self._kv_quantized()
+        kv_dt = jnp.int8 if quant else k.dtype
         max_blocks = -(-k.shape[1] // bs) if self.is_initializing() else None
         ck = self.variable(
-            "cache", "paged_k", jnp.zeros, (nb, bs, heads, dh), k.dtype
+            "cache", "paged_k", jnp.zeros, (nb, bs, heads, dh), kv_dt
         )
         cv = self.variable(
-            "cache", "paged_v", jnp.zeros, (nb, bs, heads, dh), v.dtype
+            "cache", "paged_v", jnp.zeros, (nb, bs, heads, dh), kv_dt
         )
+        if quant:
+            # One f32 scale per head per pool position, resident beside
+            # the int8 payload (same block addressing — the trash-block
+            # and prefix-sharing invariants cover scales for free).
+            cks = self.variable(
+                "cache", "paged_k_scale", jnp.zeros,
+                (nb, bs, heads, 1), jnp.float32,
+            )
+            cvs = self.variable(
+                "cache", "paged_v_scale", jnp.zeros,
+                (nb, bs, heads, 1), jnp.float32,
+            )
         bt = self.variable(
             "cache", "block_table",
             lambda: jnp.zeros((b, max_blocks), jnp.int32),
@@ -189,6 +220,21 @@ class Attention(nn.Module):
             jnp.int32(0),
         )
         flat = (pb * bs + pos % bs).reshape(-1)  # [B*t] pool row ids
+        if quant:
+            from distributeddeeplearning_tpu.ops.quant import quantize_int8
+
+            k, k_scale = quantize_int8(k, axis=-1)  # int8 + [B,t,H,1] f32
+            v, v_scale = quantize_int8(v, axis=-1)
+            cks.value = (
+                cks.value.reshape(nb * bs, heads, 1)
+                .at[flat].set(k_scale.reshape(-1, heads, 1))
+                .reshape(nb, bs, heads, 1)
+            )
+            cvs.value = (
+                cvs.value.reshape(nb * bs, heads, 1)
+                .at[flat].set(v_scale.reshape(-1, heads, 1))
+                .reshape(nb, bs, heads, 1)
+            )
         ck.value = (
             ck.value.reshape(nb * bs, heads, dh)
             .at[flat].set(k.reshape(-1, heads, dh))
@@ -206,6 +252,21 @@ class Attention(nn.Module):
         # -inf -> exact zeros in the softmax/weighted sum).
         k_all = jnp.take(ck.value, table, axis=0).reshape(b, mb * bs, heads, dh)
         v_all = jnp.take(cv.value, table, axis=0).reshape(b, mb * bs, heads, dh)
+        if quant:
+            from distributeddeeplearning_tpu.ops.quant import dequantize_int8
+
+            k_all = dequantize_int8(
+                k_all,
+                jnp.take(cks.value, table, axis=0)
+                .reshape(b, mb * bs, heads, 1),
+                self.dtype,
+            )
+            v_all = dequantize_int8(
+                v_all,
+                jnp.take(cvs.value, table, axis=0)
+                .reshape(b, mb * bs, heads, 1),
+                self.dtype,
+            )
         return self._masked_decode_scores(q, k_all, v_all, pos)
 
     def _masked_decode_scores(self, q, k_all, v_all, q_pos):
@@ -243,17 +304,42 @@ class Attention(nn.Module):
         )
         if self.paged_blocks:
             return self._paged_decode_attention(q, k, v, ci)
-        ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
-        cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
+        quant = self._kv_quantized()
+        kv_dt = jnp.int8 if quant else k.dtype
+        ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, kv_dt)
+        cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, kv_dt)
+        if quant:
+            # f32 scale per head per position (size-1 tail axis so the
+            # K-shaped write indices apply verbatim).
+            cks = self.variable(
+                "cache", "cached_k_scale", jnp.zeros,
+                k.shape[:-1] + (1,), jnp.float32,
+            )
+            cvs = self.variable(
+                "cache", "cached_v_scale", jnp.zeros,
+                v.shape[:-1] + (1,), jnp.float32,
+            )
         if self.is_initializing():
             # init traces the full-length dummy: buffers get their final
             # [B, max_len, H, Dh] shape; run the normal path for tracing.
             return dot_product_attention(q, k, v, causal=self.causal)
         t = q.shape[1]
         idx = ci.value
+        writes = [(ck, k), (cv, v)]
+        if quant:
+            from distributeddeeplearning_tpu.ops.quant import (
+                dequantize_int8,
+                quantize_int8,
+            )
+
+            kq, k_scale = quantize_int8(k, axis=-1)
+            vq, v_scale = quantize_int8(v, axis=-1)
+            writes = [(ck, kq), (cv, vq), (cks, k_scale), (cvs, v_scale)]
         if jnp.ndim(idx) == 0:
-            ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+            for var, upd in writes:
+                var.value = lax.dynamic_update_slice(
+                    var.value, upd, (0, idx, 0, 0)
+                )
             # query i sits at absolute position idx+i; it may attend to
             # all cache slots <= that position (causal + written-so-far
             # in one)
@@ -264,11 +350,15 @@ class Attention(nn.Module):
             write = jax.vmap(
                 lambda c, u, i: lax.dynamic_update_slice(c, u, (i, 0, 0))
             )
-            ck.value = write(ck.value, k, idx)
-            cv.value = write(cv.value, v, idx)
+            for var, upd in writes:
+                var.value = write(var.value, upd, idx)
             q_pos = idx[:, None] + jnp.arange(t)  # [B, t]
         ci.value = idx + t
-        k_all, v_all = ck.value, cv.value
+        if quant:
+            k_all = dequantize_int8(ck.value, cks.value, self.dtype)
+            v_all = dequantize_int8(cv.value, cvs.value, self.dtype)
+        else:
+            k_all, v_all = ck.value, cv.value
         return self._masked_decode_scores(q, k_all, v_all, q_pos)
 
     def _resolve_impl(self, x, head_dim: int) -> str:

@@ -268,6 +268,11 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
                 "serve.prefix_hits",
                 counters.get("serve.prefix_hit_blocks"),
             ),
+            # Dtype-aware byte gauges (quantized decode tier): what one
+            # cached token position / the resident params cost — int8
+            # engines report the int8 + scale bytes, never just payload.
+            "kv_bytes_per_token": gauges.get("serve.kv_bytes_per_token"),
+            "param_bytes": gauges.get("serve.param_bytes"),
             "queue_wait": span_stats.get("serve.queue_wait"),
             "ttft": span_stats.get("serve.ttft"),
             "prefill": span_stats.get("serve.prefill"),
@@ -364,6 +369,13 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
             add(
                 f"  block pool: {free:.0f}/{total:.0f} free at exit "
                 f"(final util {util:.2f}), prefix hits {hits:.0f} blocks"
+            )
+        if srv.get("kv_bytes_per_token") is not None:
+            pb = srv.get("param_bytes") or 0.0
+            add(
+                f"  bytes (dtype-aware): "
+                f"{srv['kv_bytes_per_token']:.0f} B KV/token, "
+                f"params {pb / 2**20:.1f} MiB resident"
             )
         # Per-request latency anatomy: where the time went.
         for label, key in (

@@ -1,0 +1,174 @@
+"""Symmetric int8 quantization for the inference/serving tier.
+
+Decode is bandwidth-bound (PROFILE.md; ``scripts/decode_audit.py``):
+every step streams the full parameter set plus the whole KV pool, so
+throughput scales with *bytes removed*, not FLOPs saved. This module is
+the byte-removal primitive: symmetric int8 with f32 scales —
+
+* **weights** per output channel (LLM.int8-style: one scale per column
+  of each matmul kernel, one per vocab row of the tied embedding), a
+  one-shot tree pass at engine build (:func:`quantize_params`) with
+  dequant-on-use inside the compiled decode programs
+  (:func:`dequantize_params`);
+* **KV cache** per head per position (``models/vit.Attention`` with
+  ``kv_dtype="int8"``; per *block* position in the paged layout —
+  the same per-head scale, resident in the block pool): writes
+  quantize, the decode gather dequantizes to the compute dtype before
+  the masked-score math.
+
+Everything here is pure ``jnp``, shape-preserving (scales keep reduced
+axes as size-1 so dequant is a plain broadcast multiply), and runs
+inside jit/AOT programs — no Python branches on data. Quantize →
+dequantize is deterministic (round-half-to-even), so two engines fed
+the same stream hold bitwise-identical pools
+(``tests/test_serving_quant.py``).
+
+Scales are **itemized, never hidden**: a quantized tensor's true byte
+cost is ``int8 bytes + f32 scale bytes``, and ``decode_audit`` accounts
+both against the floor (claiming the bf16 floor with int8 bytes would
+overstate ``pct_of_floor``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+# Marker keys a quantized tensor leaf expands into inside a param tree.
+# Kept dict-shaped (not a custom pytree node) so the tree still
+# flattens/unflattens with stock flax/jax utilities and jit treats the
+# int8 payload + scale as two ordinary leaves.
+Q8 = "_q8"
+Q8_SCALE = "_q8_scale"
+
+# int8 symmetric range: ±127 (the -128 code is unused so the range is
+# symmetric and q == -q round-trips exactly).
+_QMAX = 127.0
+
+
+def quantize_int8(x: jnp.ndarray, axis=-1) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization of ``x`` with one f32 scale per slice
+    along ``axis`` (int or tuple — the *reduced* axes). Returns
+    ``(q, scale)`` with ``scale`` keeping the reduced axes at size 1, so
+    ``q * scale`` broadcasts back to ``x``'s shape.
+
+    ``scale = amax / 127`` (all-zero slices get scale 1 so dequant is an
+    exact zero, not NaN); values quantize with round-half-to-even and a
+    clip that only the amax element can touch.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """``q * scale`` in f32, cast to ``dtype`` (broadcast: ``scale``
+    keeps reduced axes at size 1 — :func:`quantize_int8`'s contract)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Param-tree pass (inference weights)
+# ---------------------------------------------------------------------------
+
+def _is_quantizable(path: Tuple[str, ...], leaf) -> bool:
+    """Inference-weight rule: 2-D matmul kernels (attention qkv/proj,
+    MLP fc1/fc2, the LM head) per output channel, plus the tied token
+    embedding per vocab row — the tensors a decode step actually
+    streams in bulk. Biases, norms, positional tables and conv kernels
+    stay f32 (byte-negligible; norms are numerically load-bearing)."""
+    name = path[-1]
+    if name == "kernel" and getattr(leaf, "ndim", 0) == 2:
+        return True
+    if name == "tok_embed" and getattr(leaf, "ndim", 0) == 2:
+        return True
+    return False
+
+
+def _quant_axis(path: Tuple[str, ...]) -> int:
+    """Reduced axis for the per-channel scale: kernels ``[in, out]``
+    reduce ``in`` (one scale per output channel); the embedding
+    ``[vocab, hidden]`` reduces ``hidden`` (one scale per vocab row —
+    per-channel for BOTH of its uses: the lookup's row and the tied
+    output projection's logit column share the scale)."""
+    return 0 if path[-1] == "kernel" else -1
+
+
+def quantize_params(params: Any) -> Any:
+    """One-shot inference quantization of a param tree: every leaf
+    :func:`_is_quantizable` becomes ``{_q8: int8, _q8_scale: f32}`` in
+    place; everything else passes through untouched. Pure jnp — safe to
+    ``jax.jit`` (the engine does) or ``jax.eval_shape`` (the audit
+    does, for bytes without materializing anything)."""
+    from flax import traverse_util
+    from flax.core import unfreeze
+
+    flat = traverse_util.flatten_dict(unfreeze(params))
+    out: Dict[Tuple[str, ...], Any] = {}
+    for path, leaf in flat.items():
+        if _is_quantizable(path, leaf):
+            q, scale = quantize_int8(leaf, axis=_quant_axis(path))
+            out[path + (Q8,)] = q
+            out[path + (Q8_SCALE,)] = scale
+        else:
+            out[path] = leaf
+    return traverse_util.unflatten_dict(out)
+
+
+def dequantize_params(params: Any, dtype=jnp.float32) -> Any:
+    """Inverse tree pass (dequant-on-use): every ``{_q8, _q8_scale}``
+    pair collapses back to a dense ``dtype`` tensor. Called at the TOP
+    of a compiled decode program, so XLA sees int8 + scale as the
+    *streamed* operands and the dequantized copy as a fused temporary —
+    the per-step HBM traffic is the quantized bytes."""
+    from flax import traverse_util
+    from flax.core import unfreeze
+
+    flat = traverse_util.flatten_dict(unfreeze(params))
+    out: Dict[Tuple[str, ...], Any] = {}
+    for path, leaf in flat.items():
+        if path[-1] == Q8:
+            out[path[:-1]] = dequantize_int8(
+                leaf, flat[path[:-1] + (Q8_SCALE,)], dtype
+            )
+        elif path[-1] == Q8_SCALE:
+            continue
+        else:
+            out[path] = leaf
+    return traverse_util.unflatten_dict(out)
+
+
+def is_quantized(params: Any) -> bool:
+    """True if the tree went through :func:`quantize_params`."""
+    from flax import traverse_util
+    from flax.core import unfreeze
+
+    return any(
+        path[-1] == Q8
+        for path in traverse_util.flatten_dict(unfreeze(params))
+    )
+
+
+def tree_byte_split(tree: Any) -> Dict[str, int]:
+    """Byte accounting with scales itemized (``decode_audit``'s floor
+    contract): ``{"int8": ..., "scale": ..., "other": ...}`` summed
+    over leaves — works on real arrays and eval_shape structs alike."""
+    import numpy as np
+    from flax import traverse_util
+    from flax.core import unfreeze
+
+    out = {"int8": 0, "scale": 0, "other": 0}
+    for path, leaf in traverse_util.flatten_dict(unfreeze(tree)).items():
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        nbytes = n * np.dtype(leaf.dtype).itemsize
+        if path[-1] == Q8 or np.dtype(leaf.dtype) == np.int8:
+            out["int8"] += nbytes
+        elif path[-1] == Q8_SCALE or path[-1].endswith("_scale"):
+            out["scale"] += nbytes
+        else:
+            out["other"] += nbytes
+    return out

@@ -57,8 +57,10 @@ from distributeddeeplearning_tpu.utils.logging import get_logger
 _INDEX_NAMES = ("cache_index", "pos_index")
 # Paged layout (kv_layout="paged"): the block pools are batch-independent
 # shared tensors; the block table is per-row routing data fed each step
-# exactly like the position vectors.
-_PAGED_POOL_NAMES = ("paged_k", "paged_v")
+# exactly like the position vectors. The *_scale pools exist only under
+# kv_dtype="int8" (f32 scales resident beside the int8 payload) and
+# follow the same block addressing.
+_PAGED_POOL_NAMES = ("paged_k", "paged_v", "paged_k_scale", "paged_v_scale")
 _TABLE_NAME = "block_table"
 
 
@@ -141,12 +143,25 @@ class SlotEngine:
         block_size: int = 16,
         num_blocks: Optional[int] = None,
         prefix_cache: bool = True,
+        kv_dtype: str = "bf16",
+        weight_dtype: str = "bf16",
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if kv_layout not in ("dense", "paged"):
             raise ValueError(
                 f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
+            )
+        # "bf16" means *native* (store the model's compute dtype — the
+        # pre-quantization behaviour); "int8" engages ops/quant.py.
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}"
+            )
+        if weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'bf16' or 'int8', got "
+                f"{weight_dtype!r}"
             )
         model_max = getattr(model, "max_seq_len", None)
         if max_len is None:
@@ -164,8 +179,11 @@ class SlotEngine:
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.kv_layout = kv_layout
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         self.allocator: Optional[BlockAllocator] = None
         self.prefix_cache = bool(prefix_cache) and kv_layout == "paged"
+        quant_kw = dict(kv_dtype="int8") if kv_dtype == "int8" else {}
         if kv_layout == "paged":
             if block_size < 1:
                 raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -180,13 +198,13 @@ class SlotEngine:
             self.allocator = BlockAllocator(self.num_blocks, self.block_size)
             self.decode_model = decode_variant(
                 model, paged_blocks=self.num_blocks,
-                paged_block_size=self.block_size,
+                paged_block_size=self.block_size, **quant_kw,
             )
         else:
             self.block_size = 0
             self.blocks_per_slot = 0
             self.num_blocks = 0
-            self.decode_model = decode_variant(model)
+            self.decode_model = decode_variant(model, **quant_kw)
         bs = tuple(sorted(set(int(b) for b in (buckets or default_buckets(max_len)))))
         if not bs or bs[0] < 1:
             raise ValueError(f"invalid bucket ladder {bs}")
@@ -205,6 +223,15 @@ class SlotEngine:
             self.params = params
         else:
             self.params = jax.device_put(params)
+        # Inference weight quantization (SERVE_WEIGHT_DTYPE=int8): a
+        # one-shot tree pass — matmul kernels + the tied embedding
+        # become int8 + per-channel f32 scales; the decode programs
+        # dequantize on use, so what each step STREAMS is the quantized
+        # bytes (ops/quant.py).
+        if weight_dtype == "int8":
+            from distributeddeeplearning_tpu.ops import quant as quantlib
+
+            self.params = jax.jit(quantlib.quantize_params)(self.params)
 
         # Cache pool template: shape-only trace of the decode model's
         # init at [num_slots, max_len] (no parameter initializers run).
@@ -283,10 +310,22 @@ class SlotEngine:
 
     # -- traced programs ---------------------------------------------------
 
+    def _live_params(self, params):
+        """Dequant-on-use (``weight_dtype="int8"``): inside the traced
+        program the quantized tree is the *streamed* operand; the f32
+        view XLA rebuilds here is a fused temporary, so per-step param
+        traffic is the int8 + scale bytes."""
+        if self.weight_dtype != "int8":
+            return params
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
+        return quantlib.dequantize_params(params)
+
     def _decode_fn(
         self, params, cache, tokens, positions, step_keys, temps, top_ks,
         top_ps, eos,
     ):
+        params = self._live_params(params)
         cache = self._with_positions(cache, positions)
         logits, mutated = self.decode_model.apply(
             {"params": params, "cache": cache},
@@ -305,6 +344,7 @@ class SlotEngine:
         self, params, pool, slot, tokens, prompt_len, key, temp, top_k,
         top_p, eos,
     ):
+        params = self._live_params(params)
         # Fresh zero cache, scalar index 0: the prompt's forward IS the
         # lockstep decode path inference.generate runs — same K/V, same
         # logits at every prompt position.
@@ -342,6 +382,7 @@ class SlotEngine:
     ):
         """Paged twin of :meth:`_decode_fn`: identical math per slot —
         only the KV residency differs (block pool + table routing)."""
+        params = self._live_params(params)
         cache = self._with_positions(cache, positions, tables)
         logits, mutated = self.decode_model.apply(
             {"params": params, "cache": cache},
@@ -371,6 +412,7 @@ class SlotEngine:
         (writes begin at the block-aligned ``start``). One program per
         bucket either way: start/table/last_idx are data, so the program
         set stays closed at ``len(buckets) + 1``."""
+        params = self._live_params(params)
         cache = self._with_positions(pool, start, table_row)
         logits, mutated = self.decode_model.apply(
             {"params": params, "cache": cache},
@@ -490,6 +532,11 @@ class SlotEngine:
             self.compile_count += 1
         if paged:
             self._emit_pool_gauges()
+        acct = self.byte_accounting()
+        obs.gauge(
+            "serve.kv_bytes_per_token", float(acct["kv_bytes_per_token"])
+        )
+        obs.gauge("serve.param_bytes", float(acct["param_bytes"]))
         info = {
             "compile_sec": self.compile_sec,
             "programs": float(self.compile_count),
@@ -514,6 +561,35 @@ class SlotEngine:
     def pool_stats(self) -> Optional[Dict[str, int]]:
         """Block-pool gauges (None on the dense layout)."""
         return None if self.allocator is None else self.allocator.snapshot()
+
+    def byte_accounting(self) -> Dict[str, float]:
+        """Dtype-aware byte ledger (the ``serve.kv_bytes_per_token`` /
+        ``serve.param_bytes`` gauges, serve_bench's quant compare):
+        KV-pool bytes per cached token position — int8 payload PLUS f32
+        scales when ``kv_dtype="int8"``, never just the payload — and
+        the resident param bytes a decode step streams (a quantized
+        tree counts its int8 + scale leaves)."""
+        kv = 0
+        for path, leaf in self._template.items():
+            if path[-1] in _INDEX_NAMES or path[-1] == _TABLE_NAME:
+                continue
+            kv += (
+                int(np.prod(leaf.shape, dtype=np.int64))
+                * np.dtype(leaf.dtype).itemsize
+            )
+        positions = (
+            self.num_blocks * self.block_size if self.kv_layout == "paged"
+            else self.num_slots * self.max_len
+        )
+        param_bytes = sum(
+            leaf.size * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(self.params)
+        )
+        return {
+            "kv_pool_bytes": float(kv),
+            "kv_bytes_per_token": kv / max(positions, 1),
+            "param_bytes": float(param_bytes),
+        }
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         """Physical blocks a request writes: positions 0 ..
@@ -727,6 +803,18 @@ class SlotEngine:
             self._cursor[i] += 1
             out.append((i, int(nxt[i]), bool(eos_hit[i])))
         return out
+
+    def force_token(self, slot: int, token: int) -> None:
+        """Teacher-forcing hook for quality oracles (serve_bench's
+        quantization compare, ``tests/test_serving_quant.py``): override
+        the token the NEXT decode step feeds this slot. The step then
+        answers "given this exact context, what would the engine emit?"
+        — per-step agreement without free-running divergence cascades.
+        Positions/keys/sampling state are untouched; never use while a
+        request's own stream matters."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not occupied")
+        self._tokens[slot] = np.int32(token)
 
     def release(self, slot: int) -> None:
         """Free a slot (eviction). Pure host bookkeeping — the stale

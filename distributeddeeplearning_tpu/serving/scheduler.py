@@ -206,6 +206,12 @@ class ServeConfig:
     # block_size) + the trash block).
     num_blocks: int = 0
     prefix_cache: bool = True
+    # Quantized decode tier (docs/SERVING.md): "bf16" = native compute
+    # dtype; "int8" stores the KV pool / streams the inference weights
+    # as symmetric int8 + f32 scales (ops/quant.py). Orthogonal to
+    # kv_layout — the paged pool quantizes too.
+    kv_dtype: str = "bf16"
+    weight_dtype: str = "bf16"
     # Telemetry feedback (docs/SERVING.md): "static" = fixed admission;
     # "adaptive" = derate while a latency SLO burns, reading the live
     # plane's rollup snapshot (rollup_path; None = $OBS_DIR/rollup.json).
@@ -236,6 +242,8 @@ class ServeConfig:
             prefix_cache=str(
                 e.get("SERVE_PREFIX_CACHE", "1" if cls.prefix_cache else "0")
             ) not in ("0", "false", "off"),
+            kv_dtype=str(e.get("SERVE_KV_DTYPE", cls.kv_dtype)),
+            weight_dtype=str(e.get("SERVE_WEIGHT_DTYPE", cls.weight_dtype)),
             admission_policy=str(
                 e.get("SERVE_ADMISSION_POLICY", cls.admission_policy)
             ),
@@ -257,6 +265,7 @@ class ServeConfig:
         kw = dict(
             num_slots=self.num_slots, buckets=self.buckets,
             top_k_cap=self.top_k_cap, kv_layout=self.kv_layout,
+            kv_dtype=self.kv_dtype, weight_dtype=self.weight_dtype,
         )
         if self.kv_layout == "paged":
             kw.update(

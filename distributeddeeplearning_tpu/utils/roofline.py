@@ -1,0 +1,33 @@
+"""The one place the chip's roofline constants live.
+
+Every audit that quotes "% of floor" (``scripts/decode_audit.py``, the
+trainer-side byte accounting in PROFILE.md) divides by the same HBM
+bandwidth number. It used to be restated per script; a chip swap (v5e →
+v5p/v6e) is now ONE edit here, and every floor claim moves together.
+
+``HBM_GBPS`` is the v5e spec number PROFILE.md's trainer audits were
+calibrated against (measured step time landed at ~97 % of the floor it
+implies, so the constant is treated as trustworthy). A floor computed
+from it is only a *position* on the chip it describes — off-TPU callers
+must label it analytic (``decode_audit`` emits ``pct_of_floor: None``
+on CPU for exactly this reason).
+"""
+
+from __future__ import annotations
+
+# v5e HBM bandwidth (GB/s). PROFILE.md round-1 established this as the
+# binding resource: the training stack runs at ~97 % of the roofline
+# this number implies, so decode/serving floors are quoted against it.
+HBM_GBPS = 819.0
+
+# Label carried by every record that quotes the floor, so a number
+# archived before a chip swap can never be misread against the new
+# chip's bandwidth.
+FLOOR_BASIS = f"v5e-hbm-{HBM_GBPS:.0f}GBps"
+
+
+def floor_tokens_per_sec(batch: int, bytes_per_step: int | float) -> float:
+    """Analytic decode throughput ceiling: a decode step must stream
+    ``bytes_per_step`` from HBM, so ``batch`` sequences cannot exceed
+    ``batch * bandwidth / bytes_per_step`` tokens/sec."""
+    return batch * HBM_GBPS * 1e9 / float(bytes_per_step)

@@ -1,26 +1,50 @@
 """Attention fwd / fwd+bwd timing at long T (VERDICT r2 #8).
 
 Times the compiled forward and the compiled forward+backward (grad wrt
-q,k,v) for the flash (Pallas) and xla attention impls at T ∈ {8k, 32k},
-bf16 causal, d=128. Prints ms per call; the train step pays the
-fwd+bwd number every step.
+q,k,v) for the selected attention impls at the selected sequence
+lengths, bf16 causal, d=128. Prints ms per call per configuration and
+finishes with the usual ONE JSON record line (bench.py's contract:
+``metric``/``value``/``unit``/``detail``) so the run is archivable and
+machine-checkable. The train step pays the fwd+bwd number every step.
 
-Usage: python scripts/attn_bench.py [T ...]
+Usage::
+
+    python scripts/attn_bench.py [--seq-lens 8192,32768]
+        [--impls pallas,xla] [--batch 1] [--heads 8] [--head-dim 128]
+        [--steps 5]
+
+The xla impl materializes the [T, T] score matrix, so it is skipped
+above 8k (OOM) unless it is the only impl requested.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
+import os
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-from distributeddeeplearning_tpu.ops.attention import dot_product_attention
+# xla materializes [T, T] scores; beyond this it OOMs rather than runs.
+XLA_MAX_T = 8192
 
 
-def bench(impl: str, t: int, b: int = 1, h: int = 8, d: int = 128, steps: int = 5):
+def bench(impl: str, t: int, b: int = 1, h: int = 8, d: int = 128,
+          steps: int = 5) -> dict:
+    """One (impl, T) timing. Returns a result row; failures are
+    recorded (not raised) so one broken impl can't kill the sweep."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.ops.attention import (
+        dot_product_attention,
+    )
+
     rng = np.random.RandomState(0)
     shape = (b, t, h, d)  # BTHD layout
     q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
@@ -33,8 +57,12 @@ def bench(impl: str, t: int, b: int = 1, h: int = 8, d: int = 128, steps: int = 
     def loss(q, k, v):
         return jnp.sum(fwd(q, k, v).astype(jnp.float32))
 
-    results = {}
-    for name, fn in (("fwd", jax.jit(fwd)), ("fwd+bwd", jax.jit(jax.grad(loss, argnums=(0, 1, 2))))):
+    row = {"impl": impl, "seq_len": t, "batch": b, "heads": h,
+           "head_dim": d}
+    for name, fn in (
+        ("fwd", jax.jit(fwd)),
+        ("fwd_bwd", jax.jit(jax.grad(loss, argnums=(0, 1, 2)))),
+    ):
         try:
             out = fn(q, k, v)
             leaf = jax.tree.leaves(out)[0]
@@ -45,28 +73,69 @@ def bench(impl: str, t: int, b: int = 1, h: int = 8, d: int = 128, steps: int = 
             leaf = jax.tree.leaves(out)[0]
             float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
             ms = (time.perf_counter() - t0) / steps * 1e3
-            results[name] = ms
+            row[f"{name}_ms"] = round(ms, 2)
             print(f"{impl:7s} T={t:6d} {name:8s} {ms:9.1f} ms", flush=True)
         except Exception as e:
-            print(f"{impl:7s} T={t:6d} {name:8s} FAILED: {type(e).__name__}: {e}",
-                  flush=True)
-    if "fwd" in results and "fwd+bwd" in results:
+            row[f"{name}_error"] = f"{type(e).__name__}: {e}"
+            print(f"{impl:7s} T={t:6d} {name:8s} FAILED: "
+                  f"{type(e).__name__}: {e}", flush=True)
+    if "fwd_ms" in row and "fwd_bwd_ms" in row:
+        bwd = row["fwd_bwd_ms"] - row["fwd_ms"]
         print(
-            f"{impl:7s} T={t:6d} bwd-only {results['fwd+bwd'] - results['fwd']:9.1f} ms "
-            f"(bwd/fwd = {(results['fwd+bwd'] - results['fwd']) / results['fwd']:.1f}x)",
-            flush=True,
+            f"{impl:7s} T={t:6d} bwd-only {bwd:9.1f} ms "
+            f"(bwd/fwd = {bwd / row['fwd_ms']:.1f}x)" if row["fwd_ms"]
+            else f"{impl:7s} T={t:6d}", flush=True,
         )
+    return row
 
 
-def main():
-    ts = [int(a) for a in sys.argv[1:]] or [8192, 32768]
-    for t in ts:
-        for impl in ("pallas", "xla"):
-            if impl == "xla" and t > 8192:
-                print(f"xla     T={t:6d} skipped ([T,T] materialization OOMs)")
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seq-lens", default="8192,32768",
+                   help="comma-separated sequence lengths")
+    p.add_argument("--impls", default="pallas,xla",
+                   help="comma-separated attention impls "
+                        "(pallas | xla | auto)")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--steps", type=int, default=5,
+                   help="timed calls per configuration")
+    args = p.parse_args(argv)
+    seq_lens = [int(t) for t in args.seq_lens.split(",") if t.strip()]
+    impls = [i.strip() for i in args.impls.split(",") if i.strip()]
+    if not seq_lens or not impls:
+        p.error("--seq-lens and --impls must be non-empty")
+
+    import jax
+
+    rows, skipped = [], []
+    for t in seq_lens:
+        for impl in impls:
+            if impl == "xla" and t > XLA_MAX_T and len(impls) > 1:
+                print(f"xla     T={t:6d} skipped "
+                      f"([T,T] materialization OOMs)", flush=True)
+                skipped.append({"impl": impl, "seq_len": t,
+                                "reason": "xla_oom"})
                 continue
-            bench(impl, t)
+            rows.append(bench(impl, t, b=args.batch, h=args.heads,
+                              d=args.head_dim, steps=args.steps))
+    # Headline: the fwd+bwd ms of the last successful row (the largest
+    # T of the preferred impl — what the train step pays per step).
+    timed = [r for r in rows if "fwd_bwd_ms" in r]
+    record = {
+        "metric": "attn_fwd_bwd_ms",
+        "value": timed[-1]["fwd_bwd_ms"] if timed else 0.0,
+        "unit": "ms",
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "rows": rows,
+            "skipped": skipped,
+        },
+    }
+    print(json.dumps(record), flush=True)
+    return 0 if timed else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -10,7 +10,10 @@ regression. Records now carry a ``tier`` (``bench.py``): ``"cpu"`` =
 relay down, protocol re-run on the CPU fallback; ``"outage"`` = nothing
 could run. Neither is comparable to a TPU round, so both are **listed
 but skipped** — as are legacy outage records (``error`` / value ≤ 0
-with no tier) and cross-platform pairs.
+with no tier), cross-platform pairs, and pairs whose
+``kv_dtype``/``weight_dtype`` changed (a re-quantized protocol is a new
+baseline, not a regression; records predating the quantized tier count
+as the native "bf16" config).
 
 A drop > ``--threshold`` (default 10%) between *consecutive comparable*
 records of the same metric+platform exits nonzero — the CI tripwire
@@ -88,17 +91,27 @@ def analyze(
     )
     rows: List[Dict[str, Any]] = []
     regressions: List[Dict[str, Any]] = []
-    # metric -> last comparable (round, value, platform)
+    # metric -> last comparable (round, value, platform, dtypes)
     last: Dict[str, Dict[str, Any]] = {}
     for e in entries:
         rec = e["record"] or {}
         skip = classify(e)
+        detail = rec.get("detail") or {}
         row = {
             "round": e["round"],
             "metric": rec.get("metric"),
             "value": rec.get("value"),
             "unit": rec.get("unit"),
-            "platform": (rec.get("detail") or {}).get("platform"),
+            "platform": detail.get("platform"),
+            # A kv_dtype/weight_dtype change is a protocol change, not a
+            # regression — same treatment as a platform change. Records
+            # predating the quantized tier carry no dtype fields; they
+            # ran the native ("bf16") engines, so absent normalizes to
+            # that and stays comparable.
+            "dtypes": (
+                detail.get("kv_dtype") or "bf16",
+                detail.get("weight_dtype") or "bf16",
+            ),
             "skip": skip,
             "delta_pct": None,
         }
@@ -106,7 +119,11 @@ def analyze(
             metric = rec["metric"]
             value = float(rec["value"])
             prev = last.get(metric)
-            if prev is not None and prev["platform"] == row["platform"]:
+            if (
+                prev is not None
+                and prev["platform"] == row["platform"]
+                and prev["dtypes"] == row["dtypes"]
+            ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
                 if delta < -threshold:
@@ -118,14 +135,19 @@ def analyze(
                         "to_value": value,
                         "drop_pct": round(-delta * 100.0, 2),
                     })
-            elif prev is not None:
+            elif prev is not None and prev["platform"] != row["platform"]:
                 row["skip"] = (
                     f"platform_change:{prev['platform']}->{row['platform']}"
+                )
+            elif prev is not None:
+                row["skip"] = (
+                    f"dtype_change:{'/'.join(prev['dtypes'])}"
+                    f"->{'/'.join(row['dtypes'])}"
                 )
             if row["skip"] is None:
                 last[metric] = {
                     "round": e["round"], "value": value,
-                    "platform": row["platform"],
+                    "platform": row["platform"], "dtypes": row["dtypes"],
                 }
         rows.append(row)
     return {

@@ -29,11 +29,23 @@ int32 block tables the gather indexes through. Leaving the table bytes
 out would overstate ``pct_of_floor`` in paged mode; they are itemized as
 ``block_table_bytes`` in each row.
 
+**Quantized mode** (``--kv-dtype int8`` / ``--weight-dtype int8``, the
+serving tier's ``SERVE_KV_DTYPE``/``SERVE_WEIGHT_DTYPE``): the floor is
+recomputed from the bytes the quantized programs actually stream — int8
+K/V + the f32 per-head scale buffers (itemized ``kv_scale_bytes``), and
+int8 kernels/embedding + their per-channel scales (itemized
+``param_scale_bytes``). Scales are *in* the floor, never hidden:
+claiming the bf16 floor with int8 bytes would overstate
+``pct_of_floor``. Measurement then runs through a real quantized
+``SlotEngine`` decode loop (``inference.generate`` has no quantized
+path — the serving engine is the product surface for it).
+
 Usage::
 
     python scripts/decode_audit.py [--model lm_small] [--prompt-len 128]
         [--new-tokens 128] [--batches 1,2,4,8,16,32,64]
         [--kv-layout dense|paged] [--block-size 16]
+        [--kv-dtype bf16|int8] [--weight-dtype bf16|int8]
         [--profile-dir /tmp/decode_trace]
 
 Prints a per-batch table and ONE summary JSON line.
@@ -54,8 +66,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-HBM_GBPS = 819.0  # v5e (PROFILE.md constant used by every trainer audit)
-FLOOR_BASIS = f"v5e-hbm-{HBM_GBPS:.0f}GBps"
+# The chip constants live in ONE shared module (utils/roofline.py) so a
+# chip swap is a single edit; re-exported here for existing importers.
+from distributeddeeplearning_tpu.utils.roofline import (  # noqa: E402
+    FLOOR_BASIS,
+    HBM_GBPS,
+)
 
 
 def tree_bytes(tree) -> int:
@@ -67,13 +83,15 @@ def tree_bytes(tree) -> int:
 
 
 def sweep_row(b: int, tps: float, kv_bytes: int, bytes_per_step: int,
-              floor: float, on_tpu: bool, table_bytes: int = 0) -> dict:
+              floor: float, on_tpu: bool, table_bytes: int = 0,
+              kv_scale_bytes: int = 0) -> dict:
     """One sweep record. VERDICT r5 item 8: the byte floor is a v5e HBM
     roofline — off-chip (CPU smoke) it is NOT a position, so
     ``pct_of_floor`` is emitted as None there and the analytic floor is
     kept under an explicitly-labelled key instead. ``table_bytes`` (paged
-    mode) is already inside ``bytes_per_step``; it is itemized so the
-    floor's paged overhead stays auditable."""
+    mode) and ``kv_scale_bytes`` (int8 mode: the f32 per-head scale
+    buffers) are already inside ``bytes_per_step``; they are itemized so
+    the floor's overheads stay auditable."""
     row = {
         "batch": b,
         "tokens_per_sec": round(tps, 1),
@@ -85,6 +103,8 @@ def sweep_row(b: int, tps: float, kv_bytes: int, bytes_per_step: int,
     }
     if table_bytes:
         row["block_table_bytes"] = int(table_bytes)
+    if kv_scale_bytes:
+        row["kv_scale_bytes"] = int(kv_scale_bytes)
     return row
 
 
@@ -97,13 +117,17 @@ def format_row(row: dict) -> str:
             f"{pct_str} {row['kv_cache_mb']:>10.1f}")
 
 
-def paged_step_bytes(model, b: int, max_len: int, block_size: int):
+def paged_step_bytes(model, b: int, max_len: int, block_size: int,
+                     kv_dtype: str = "bf16"):
     """Per-decode-step streamed KV bytes of the PAGED layout for ``b``
     co-resident sequences: the table-gathered K/V view (each sequence
     reads its ``blocks_per_slot`` blocks — block-rounded ``max_len``)
-    plus the int32 block tables the gather routes through. Shape-only
-    (``eval_shape`` of the paged decode clone's init — exactly how the
-    serving engine sizes its pool)."""
+    plus the int32 block tables the gather routes through, plus — under
+    ``kv_dtype="int8"`` — the f32 per-head scale pools gathered beside
+    the payload (itemized as scale bytes). Shape-only (``eval_shape`` of
+    the paged decode clone's init — exactly how the serving engine sizes
+    its pool). Returns (view_bytes, table_bytes, scale_bytes); the view
+    EXCLUDES scales so callers can itemize."""
     import jax
     import jax.numpy as jnp
     from flax import traverse_util
@@ -112,7 +136,8 @@ def paged_step_bytes(model, b: int, max_len: int, block_size: int):
 
     mb = -(-max_len // block_size)
     paged_model = decode_variant(
-        model, paged_blocks=b * mb + 1, paged_block_size=block_size
+        model, paged_blocks=b * mb + 1, paged_block_size=block_size,
+        kv_dtype=kv_dtype,
     )
     shapes = jax.eval_shape(
         lambda r: paged_model.init(
@@ -120,30 +145,42 @@ def paged_step_bytes(model, b: int, max_len: int, block_size: int):
         ),
         jax.random.PRNGKey(0),
     )["cache"]
-    view_bytes = table_bytes = 0
+    view_bytes = table_bytes = scale_bytes = 0
     for path, leaf in traverse_util.flatten_dict(dict(shapes)).items():
         if path[-1] == "block_table":
             table_bytes += math.prod(leaf.shape) * 4
-        elif path[-1] in ("paged_k", "paged_v"):
-            _, bs, heads, dh = leaf.shape
-            view_bytes += (
-                b * mb * bs * heads * dh * np.dtype(leaf.dtype).itemsize
-            )
-    return view_bytes, table_bytes
+        elif path[-1] in ("paged_k", "paged_v", "paged_k_scale",
+                          "paged_v_scale"):
+            _, bs, heads, tail = leaf.shape
+            n = b * mb * bs * heads * tail * np.dtype(leaf.dtype).itemsize
+            if path[-1].endswith("_scale"):
+                scale_bytes += n
+            else:
+                view_bytes += n
+    return view_bytes, table_bytes, scale_bytes
 
 
-def measure_paged(model, params, b: int, prompt_len: int, new_tokens: int,
-                  block_size: int, vocab: int, reps: int = 3) -> float:
-    """Measured paged-decode throughput: ``b`` requests co-resident in a
-    block-pool SlotEngine, timing the batched decode steps (the path the
-    byte floor describes; prefill is the one-off outside it)."""
+def measure_engine(model, params, b: int, prompt_len: int, new_tokens: int,
+                   vocab: int, reps: int = 3, *, kv_layout: str = "dense",
+                   block_size: int = 16, kv_dtype: str = "bf16",
+                   weight_dtype: str = "bf16") -> float:
+    """Measured engine-decode throughput: ``b`` requests co-resident in
+    a SlotEngine (dense or block-pool layout, native or int8 dtypes),
+    timing the batched decode steps (the path the byte floor describes;
+    prefill is the one-off outside it). The quantized configurations
+    only exist on this path — ``inference.generate`` stays
+    native-dtype."""
     from distributeddeeplearning_tpu.serving import ReqSpec, SlotEngine
 
     max_len = prompt_len + new_tokens
+    paged_kw = (
+        dict(block_size=block_size, prefix_cache=False)
+        if kv_layout == "paged" else {}
+    )
     engine = SlotEngine(
         model, params, num_slots=b, max_len=max_len,
-        buckets=(prompt_len,), kv_layout="paged", block_size=block_size,
-        prefix_cache=False,
+        buckets=(prompt_len,), kv_layout=kv_layout,
+        kv_dtype=kv_dtype, weight_dtype=weight_dtype, **paged_kw,
     )
     engine.warmup()
     rng = np.random.RandomState(0)
@@ -176,12 +213,14 @@ def measure_paged(model, params, b: int, prompt_len: int, new_tokens: int,
 
 def audit(model_name: str, prompt_len: int, new_tokens: int,
           batches, profile_dir=None, vocab: int = 32000,
-          kv_layout: str = "dense", block_size: int = 16):
+          kv_layout: str = "dense", block_size: int = 16,
+          kv_dtype: str = "bf16", weight_dtype: str = "bf16"):
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
+    from flax import traverse_util
 
-    from distributeddeeplearning_tpu.inference import generate
+    from distributeddeeplearning_tpu.inference import decode_variant, generate
     from distributeddeeplearning_tpu.models import get_model
 
     max_len = prompt_len + new_tokens
@@ -191,29 +230,52 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
         train=False,
     )
     params = nn.unbox(variables["params"])
-    param_bytes = tree_bytes(params)
+    # Param bytes a decode step streams, dtype-aware: with int8 weights
+    # the floor charges the quantized kernels/embedding PLUS their f32
+    # per-channel scales (itemized — a bf16 floor quoted over int8
+    # bytes would overstate pct_of_floor). Shape-only eval_shape of the
+    # quantization pass; nothing is materialized here.
+    param_scale_bytes = 0
+    if weight_dtype == "int8":
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
+        split = quantlib.tree_byte_split(
+            jax.eval_shape(quantlib.quantize_params, params)
+        )
+        param_bytes = split["int8"] + split["scale"] + split["other"]
+        param_scale_bytes = split["scale"]
+    else:
+        param_bytes = tree_bytes(params)
 
     # KV-cache bytes for batch b: shape-only trace of the decode clone's
-    # init (exactly how inference.generate sizes its buffers).
-    decode_model = model.clone(decode=True, attn_impl="xla", seq_axis=None)
+    # init (exactly how inference.generate / the engine size buffers);
+    # int8 mode's f32 scale buffers come back itemized.
+    decode_model = decode_variant(model, kv_dtype=kv_dtype)
 
-    def cache_bytes(b: int) -> int:
+    def cache_byte_split(b: int):
         shapes = jax.eval_shape(
             lambda r: decode_model.init(
                 r, jnp.zeros((b, max_len), jnp.int32), train=False
             ),
             jax.random.PRNGKey(0),
         )["cache"]
-        return sum(
-            math.prod(s.shape) * np.dtype(s.dtype).itemsize
-            for s in jax.tree.leaves(shapes)
-        )
+        kv = scale = 0
+        for path, leaf in traverse_util.flatten_dict(dict(shapes)).items():
+            n = math.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+            if path[-1].endswith("_scale"):
+                scale += n
+            else:
+                kv += n
+        return kv, scale
 
+    quantized = kv_dtype == "int8" or weight_dtype == "int8"
     rows = []
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     print(f"# {model_name} decode audit on {platform}: params "
-          f"{param_bytes / 2**20:.1f} MiB, max_len {max_len}", flush=True)
+          f"{param_bytes / 2**20:.1f} MiB "
+          f"(weights {weight_dtype}, kv {kv_dtype}), max_len {max_len}",
+          flush=True)
     if not on_tpu:
         print(f"# NOTE: floor column is the ANALYTIC v5e byte floor "
               f"({FLOOR_BASIS}); on {platform} it is not a roofline "
@@ -223,16 +285,31 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
     import contextlib
 
     for i, b in enumerate(batches):
-        table_bytes = 0
+        table_bytes = scale_bytes = 0
         if kv_layout == "paged":
-            kv, table_bytes = paged_step_bytes(model, b, max_len, block_size)
-            bytes_per_step = param_bytes + kv + table_bytes
+            kv, table_bytes, scale_bytes = paged_step_bytes(
+                model, b, max_len, block_size, kv_dtype
+            )
+            bytes_per_step = param_bytes + kv + scale_bytes + table_bytes
             floor = b * HBM_GBPS * 1e9 / bytes_per_step
-            tps = measure_paged(
-                model, params, b, prompt_len, new_tokens, block_size, vocab
+            tps = measure_engine(
+                model, params, b, prompt_len, new_tokens, vocab,
+                kv_layout="paged", block_size=block_size,
+                kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+            )
+        elif quantized:
+            kv, scale_bytes = cache_byte_split(b)
+            bytes_per_step = param_bytes + kv + scale_bytes
+            floor = b * HBM_GBPS * 1e9 / bytes_per_step
+            # generate() has no quantized path — measure the batched
+            # decode loop of a real quantized engine (the serving
+            # tier's product surface for these dtypes).
+            tps = measure_engine(
+                model, params, b, prompt_len, new_tokens, vocab,
+                kv_dtype=kv_dtype, weight_dtype=weight_dtype,
             )
         else:
-            kv = cache_bytes(b)
+            kv, _ = cache_byte_split(b)
             bytes_per_step = param_bytes + kv
             floor = b * HBM_GBPS * 1e9 / bytes_per_step
             rng = np.random.RandomState(0)
@@ -257,7 +334,7 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
                 dt = time.perf_counter() - t0
             tps = reps * b * new_tokens / dt
         row = sweep_row(b, tps, kv, bytes_per_step, floor, on_tpu,
-                        table_bytes=table_bytes)
+                        table_bytes=table_bytes, kv_scale_bytes=scale_bytes)
         rows.append(row)
         print(format_row(row), flush=True)
     out = {
@@ -266,6 +343,8 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "kv_layout": kv_layout,
+        "kv_dtype": kv_dtype,
+        "weight_dtype": weight_dtype,
         "param_bytes_mb": round(param_bytes / 2**20, 1),
         "hbm_gbps": HBM_GBPS,
         "floor_basis": FLOOR_BASIS,
@@ -274,6 +353,8 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
         "floor_applicable": on_tpu,
         "sweep": rows,
     }
+    if param_scale_bytes:
+        out["param_scale_bytes"] = int(param_scale_bytes)
     if kv_layout == "paged":
         out["block_size"] = block_size
     return out
@@ -293,12 +374,16 @@ def main(argv=None) -> int:
     p.add_argument("--kv-layout", choices=("dense", "paged"),
                    default="dense")
     p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16")
+    p.add_argument("--weight-dtype", choices=("bf16", "int8"),
+                   default="bf16")
     p.add_argument("--profile-dir", default=None)
     args = p.parse_args(argv)
     batches = [int(b) for b in args.batches.split(",") if b.strip()]
     out = audit(args.model, args.prompt_len, args.new_tokens, batches,
                 profile_dir=args.profile_dir, vocab=args.vocab,
-                kv_layout=args.kv_layout, block_size=args.block_size)
+                kv_layout=args.kv_layout, block_size=args.block_size,
+                kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
     print(json.dumps(out))
     return 0
 

@@ -19,6 +19,9 @@ Protocols (all via bench.py's existing modes — no new measurement code):
     serve_lm      scripts/serve_bench.py (32k vocab)   tokens/sec
     serve_lm_paged  serve_bench dense-vs-paged A/B at  tokens/sec
                     a fixed pool-byte budget (longtail)
+    serve_lm_int8   serve_bench bf16-vs-int8 (KV +     tokens/sec
+                    weights) at a fixed byte budget,
+                    teacher-forced match-rate oracle
 
 Usage::
 
@@ -88,6 +91,20 @@ PROTOCOLS = {
         "SERVE_SLOTS": "16", "SERVE_POOL_SLOT_BUDGET": "4",
         "SERVE_BLOCK_SIZE": "16",
     },
+    # Quantized decode tier (docs/SERVING.md): bf16 vs int8 KV+weights
+    # engines at the SAME KV-pool byte budget on a decode-heavy greedy
+    # load — the row's JSON line carries both runs, tps/capacity ratios
+    # and the teacher-forced greedy match rate, and the script exits
+    # non-zero unless match >= 0.95 AND int8 tokens/sec >= bf16 with
+    # zero recompiles and closed program sets on both engines.
+    "serve_lm_int8": {
+        "_script": "scripts/serve_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_KV_DTYPE": "int8", "SERVE_WEIGHT_DTYPE": "int8",
+        "SERVE_PROFILE": "mixed", "SERVE_MAX_NEW": "32",
+        "SERVE_REQUESTS": "48", "SERVE_RATE_RPS": "0",
+        "SERVE_POOL_SLOT_BUDGET": "4", "SERVE_PREFILLS_PER_STEP": "4",
+    },
 }
 
 
@@ -104,6 +121,7 @@ _PROTOCOL_VARS = (
     "SERVE_DEADLINE_MS", "SERVE_PREFILLS_PER_STEP", "SERVE_TOP_K_CAP",
     "SERVE_KV_LAYOUT", "SERVE_PROFILE", "SERVE_BLOCK_SIZE",
     "SERVE_NUM_BLOCKS", "SERVE_PREFIX_CACHE", "SERVE_POOL_SLOT_BUDGET",
+    "SERVE_KV_DTYPE", "SERVE_WEIGHT_DTYPE", "SERVE_QUANT_MATCH_MIN",
 )
 
 

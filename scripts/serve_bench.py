@@ -23,6 +23,24 @@ requests is served by up to three configurations:
   non-zero unless paged reaches ≥2× dense peak concurrency (or ≥1.5×
   tokens/sec) with bitwise per-request parity and zero mid-measure
   recompiles on BOTH engines.
+* **quantization compare** (``SERVE_KV_DTYPE=int8`` and/or
+  ``SERVE_WEIGHT_DTYPE=int8`` — docs/SERVING.md): the bf16 (native)
+  engine at ``SERVE_POOL_SLOT_BUDGET`` dense slots vs the quantized
+  engine given the SAME KV-pool bytes — int8 + scales pack ~2–3.5× the
+  slots into the budget, so the quantized engine's capacity (and, with
+  the per-step cost amortized over more co-resident requests, its
+  tokens/sec) certifies the byte win. The load runs GREEDY; exact
+  parity is mathematically unavailable under quantization (one flipped
+  argmax re-conditions the whole suffix), so quality is gated by a
+  **teacher-forced greedy token-match-rate oracle**: every reference
+  stream is replayed through the quantized engine with the context
+  forced to the bf16 tokens (``SlotEngine.force_token``) and per-step
+  agreement must reach ``SERVE_QUANT_MATCH_MIN`` (0.95). The
+  free-running positional match and the weight-quantization logit
+  error are reported alongside, unGated (documented like the accum ULP
+  note). Exits non-zero unless match ≥ threshold AND quantized
+  tokens/sec ≥ bf16 with zero mid-measure recompiles and closed
+  program sets on BOTH engines.
 
 Env knobs (defaults in parentheses): ``SERVE_SLOTS`` (8),
 ``SERVE_BUCKETS`` ("8,16"; compare/longtail default covers the long
@@ -32,6 +50,8 @@ all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail),
 ``SERVE_KV_LAYOUT`` (dense | paged | compare), ``SERVE_BLOCK_SIZE``
 (16), ``SERVE_NUM_BLOCKS`` (0 = dense-equivalent),
 ``SERVE_POOL_SLOT_BUDGET`` (4 — the fixed byte budget, in dense slots),
+``SERVE_KV_DTYPE`` / ``SERVE_WEIGHT_DTYPE`` (bf16 — int8 selects the
+quantization compare), ``SERVE_QUANT_MATCH_MIN`` (0.95),
 ``BENCH_MODEL`` (lm_tiny), ``BENCH_VOCAB`` (32000), plus the generic
 ``OBS_DIR``/``--events`` and ``COMPILATION_CACHE_DIR`` plumbing
 bench.py uses. With ``SLO_SPEC`` set (and ``OBS_DIR``) the bench runs
@@ -171,7 +191,9 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
                      admission_policy=None):
     """Build + warm one engine, replay the request schedule through it,
     and report throughput, concurrency, latency percentiles, parity
-    against the sequential outputs and the compile ledger."""
+    against the sequential outputs (None skips the check — the quant
+    compare has no bitwise reference) and the compile ledger. Returns
+    ``(record, per-request new-token streams, engine)``."""
     import numpy as np
 
     from distributeddeeplearning_tpu.serving import Server, SlotEngine
@@ -191,7 +213,7 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
 
     tps, handles, wall_s = run_continuous(server, reqs, temperature, top_k)
 
-    parity = all(
+    parity = None if seq_outs is None else all(
         np.array_equal(h.tokens, seq_outs[i][: len(h.tokens)])
         for i, h in enumerate(handles)
     )
@@ -203,7 +225,7 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
     out = {
         "kv_layout": engine.kv_layout,
         "tokens_per_sec": round(tps, 1),
-        "parity": bool(parity),
+        "parity": None if parity is None else bool(parity),
         "slots": engine.num_slots,
         "peak_concurrent": server.stats["peak_active"],
         "ttft_p50_ms": round(_percentile(ttft_ms, 0.5), 2),
@@ -231,7 +253,225 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
                 snap["peak_live"] / snap["capacity"], 3
             ) if snap["capacity"] else 0.0,
         }
-    return out
+    return out, [list(h.new_tokens) for h in handles], engine
+
+
+def kv_slot_bytes(model, max_len: int, kv_dtype: str) -> int:
+    """Per-slot KV bytes of a dense cache row at ``max_len`` — int8
+    payload PLUS f32 scales when quantized (shape-only eval_shape; the
+    quant compare sizes the quantized engine's slot count so both
+    engines hold the SAME pool bytes)."""
+    import math
+
+    import numpy as np
+    from flax import traverse_util
+
+    from distributeddeeplearning_tpu.inference import (
+        decode_cache_shapes,
+        decode_variant,
+    )
+
+    shapes = decode_cache_shapes(
+        decode_variant(model, kv_dtype=kv_dtype), 1, max_len
+    )
+    total = 0
+    for path, leaf in traverse_util.flatten_dict(dict(shapes)).items():
+        if path[-1] in ("cache_index", "pos_index"):
+            continue
+        total += math.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def teacher_forced_match(engine, reqs, ref_streams):
+    """The quantization quality oracle: per-step greedy agreement with
+    the reference context FORCED (``SlotEngine.force_token``). Each
+    reference stream replays through the quantized engine; at every
+    step the engine answers "given this exact bf16-produced history,
+    which token would I emit?" and agreement is counted. Free-running
+    comparison would conflate per-step quality with divergence cascades
+    (one flip re-conditions the suffix), which is why it is reported
+    but not gated."""
+    from distributeddeeplearning_tpu.serving import ReqSpec
+
+    total = matched = 0
+    i = 0
+    active = {}  # slot -> (stream, next position to compare)
+    while i < len(reqs) or active:
+        for slot in engine.free_slots:
+            if i >= len(reqs):
+                break
+            r, stream = reqs[i], ref_streams[i]
+            i += 1
+            first, _ = engine.prefill(slot, ReqSpec(
+                prompt=r["prompt"], max_new_tokens=len(stream),
+                temperature=0.0,
+            ))
+            total += 1
+            matched += int(first == stream[0])
+            if len(stream) == 1:
+                engine.release(slot)
+            else:
+                engine.force_token(slot, int(stream[0]))
+                active[slot] = (stream, 1)
+        if not active:
+            continue
+        for slot, tok, _eos in engine.decode_step():
+            if slot not in active:
+                continue
+            stream, c = active[slot]
+            total += 1
+            matched += int(tok == stream[c])
+            c += 1
+            if c >= len(stream):
+                engine.release(slot)
+                del active[slot]
+            else:
+                engine.force_token(slot, int(stream[c - 1]))
+                active[slot] = (stream, c)
+    return matched / max(total, 1)
+
+
+def positional_match(ref_streams, q_streams):
+    """Free-running positional agreement (reported, not gated)."""
+    tot = hit = 0
+    for a, b in zip(ref_streams, q_streams):
+        tot += max(len(a), len(b))
+        hit += sum(x == y for x, y in zip(a, b))
+    return hit / max(tot, 1)
+
+
+def weight_logit_err(model, params, reqs, ref_streams, n_seq: int = 2):
+    """Per-step logit error of the weight quantization alone: a
+    teacher-forced full forward over reference sequences with exact vs
+    dequantized-int8 params (max over positions of max-abs logit
+    delta). The KV-cache quantization's contribution is covered by the
+    engine-level match oracle; this isolates the weights."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.ops import quant as quantlib
+
+    dq = quantlib.dequantize_params(quantlib.quantize_params(params))
+    err = 0.0
+    for r, s in list(zip(reqs, ref_streams))[:n_seq]:
+        toks = np.concatenate([r["prompt"], np.asarray(s, np.int32)])
+        toks = jnp.asarray(toks[None, :])
+        lr = model.apply({"params": params}, toks, train=False)
+        lq = model.apply({"params": dq}, toks, train=False)
+        err = max(err, float(jnp.max(jnp.abs(
+            lr.astype(jnp.float32) - lq.astype(jnp.float32)
+        ))))
+    return err
+
+
+def run_quant_compare(model, params, reqs, cfg, metric, *, budget_slots,
+                      max_len, profile, rate_rps, match_min):
+    """The quantized-decode certification: bf16 (native) engine at
+    ``budget_slots`` dense slots vs the int8 engine holding the SAME
+    KV-pool bytes (more slots — the byte win expressed as capacity),
+    same seeded greedy load. Gates: teacher-forced greedy match rate ≥
+    ``match_min``, quantized tokens/sec ≥ bf16, zero mid-measure
+    recompiles and closed program sets on both engines (the quality
+    replay reuses the warmed quantized engine, so it proves the oracle
+    itself compiled nothing)."""
+    import jax
+
+    common = dict(
+        queue_depth=cfg.queue_depth,
+        prefills_per_step=cfg.prefills_per_step,
+        temperature=0.0, top_k=None,
+        admission_policy=cfg.build_admission_policy(),
+    )
+    ref_run, ref_streams, ref_engine = serve_one_engine(
+        model, params, reqs, None,
+        engine_kwargs=dict(
+            num_slots=budget_slots, max_len=max_len, buckets=cfg.buckets,
+        ),
+        **common,
+    )
+    native_b = kv_slot_bytes(model, max_len, "bf16")
+    quant_b = kv_slot_bytes(model, max_len, cfg.kv_dtype)
+    slots_q = max(budget_slots, int(budget_slots * native_b // quant_b))
+    q_run, q_streams, q_engine = serve_one_engine(
+        model, params, reqs, None,
+        engine_kwargs=dict(
+            num_slots=slots_q, max_len=max_len, buckets=cfg.buckets,
+            kv_dtype=cfg.kv_dtype, weight_dtype=cfg.weight_dtype,
+        ),
+        **common,
+    )
+    # Quality oracle on the SAME warmed quantized engine: the replay
+    # must compile nothing (force_token is pure host data).
+    compile_pre = q_engine.compile_count
+    match = teacher_forced_match(q_engine, reqs, ref_streams)
+    free_match = positional_match(ref_streams, q_streams)
+    logit_err = (
+        weight_logit_err(model, params, reqs, ref_streams)
+        if cfg.weight_dtype == "int8" else None
+    )
+    tps_ratio = (
+        q_run["tokens_per_sec"] / ref_run["tokens_per_sec"]
+        if ref_run["tokens_per_sec"] else 0.0
+    )
+    capacity_ratio = (
+        q_run["peak_concurrent"] / ref_run["peak_concurrent"]
+        if ref_run["peak_concurrent"] else 0.0
+    )
+    detail = {
+        "profile": profile,
+        "requests": len(reqs),
+        "buckets": list(cfg.buckets),
+        "rate_rps": rate_rps,
+        "max_len": max_len,
+        "platform": jax.devices()[0].platform,
+        "kv_dtype": cfg.kv_dtype,
+        "weight_dtype": cfg.weight_dtype,
+        "pool_budget_slots": budget_slots,
+        "kv_slot_bytes": {"bf16": int(native_b), "int8": int(quant_b)},
+        "kv_bytes_per_token": {
+            "bf16": ref_engine.byte_accounting()["kv_bytes_per_token"],
+            "int8": q_engine.byte_accounting()["kv_bytes_per_token"],
+        },
+        "param_bytes": {
+            "bf16": ref_engine.byte_accounting()["param_bytes"],
+            "int8": q_engine.byte_accounting()["param_bytes"],
+        },
+        "bf16": ref_run,
+        "int8": q_run,
+        "tps_ratio": round(tps_ratio, 2),
+        "capacity_ratio": round(capacity_ratio, 2),
+        # Teacher-forced per-step agreement (GATED) vs free-running
+        # positional agreement (reported): see docs/SERVING.md — exact
+        # parity is mathematically unavailable under quantization.
+        "match_rate": round(match, 4),
+        "match_rate_min": match_min,
+        "match_rate_freerun": round(free_match, 4),
+        "weight_logit_err_max": (
+            None if logit_err is None else round(logit_err, 5)
+        ),
+    }
+    clean = (
+        ref_run["compiles_during_measure"] == 0
+        and q_run["compiles_during_measure"] == 0
+        and q_engine.compile_count == compile_pre
+    )
+    closed = all(
+        r["compile_count"] == r["programs_expected"]
+        for r in (ref_run, q_run)
+    )
+    ok = (
+        clean and closed and match >= match_min and tps_ratio >= 1.0
+    )
+    record = {
+        "metric": metric,
+        # headline: quantized throughput at the shared byte budget
+        "value": q_run["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps_ratio, 2),
+        "detail": detail,
+    }
+    _emit_record(record)
+    return 0 if ok else 1
 
 
 def start_live_plane(obs_dir):
@@ -318,9 +558,20 @@ def main() -> int:
     if cfg.buckets is None:
         cfg.buckets = (8, 16) if profile == "mixed" else (8, 16, 32, 64, 96)
     max_len = max(tp + n_new for tp, n_new in shapes)
-    temperature, top_k = 0.8, 40
+    # Quantization compare (SERVE_KV_DTYPE / SERVE_WEIGHT_DTYPE=int8):
+    # its own mode — greedy load (the match-rate oracle's regime),
+    # engine-vs-engine at a fixed KV-pool byte budget.
+    quant = cfg.kv_dtype == "int8" or cfg.weight_dtype == "int8"
+    if quant and layout != "dense":
+        raise SystemExit(
+            "the quantization compare runs on the dense layout — unset "
+            "SERVE_KV_LAYOUT or the int8 dtypes"
+        )
+    match_min = float(env.get("SERVE_QUANT_MATCH_MIN", "0.95"))
+    temperature, top_k = (0.0, None) if quant else (0.8, 40)
     metric = (
-        "serve_paged_vs_dense_capacity" if layout == "compare"
+        "serve_int8_vs_bf16_tokens_per_sec" if quant
+        else "serve_paged_vs_dense_capacity" if layout == "compare"
         else "serve_continuous_tokens_per_sec"
     )
 
@@ -335,6 +586,14 @@ def main() -> int:
         )
         params = nn.unbox(variables["params"])
         reqs = build_requests(n_requests, rate_rps, seed, vocab, shapes)
+
+        if quant:
+            return run_quant_compare(
+                model, params, reqs, cfg, metric,
+                budget_slots=budget_slots, max_len=max_len,
+                profile=profile, rate_rps=rate_rps,
+                match_min=match_min,
+            )
 
         seq_tps, seq_outs, seq_shapes = run_sequential(
             model, params, reqs, temperature, top_k
@@ -351,7 +610,7 @@ def main() -> int:
         )
         runs = {}
         if layout in ("dense", "compare"):
-            runs["dense"] = serve_one_engine(
+            runs["dense"], _, _ = serve_one_engine(
                 model, params, reqs, seq_outs,
                 engine_kwargs=dict(
                     num_slots=(
@@ -366,7 +625,7 @@ def main() -> int:
                 admission_policy=cfg.build_admission_policy(),
             )
         if layout in ("paged", "compare"):
-            runs["paged"] = serve_one_engine(
+            runs["paged"], _, _ = serve_one_engine(
                 model, params, reqs, seq_outs,
                 engine_kwargs=paged_kwargs,
                 queue_depth=cfg.queue_depth,

@@ -1,0 +1,65 @@
+"""First coverage for ``scripts/attn_bench.py`` (satellite of the
+quantized-decode PR): the bare ``sys.argv`` parsing became argparse
+(``--seq-lens``/``--impls``) and the sweep now ends with bench.py's
+one-line JSON record — both contracts pinned here on the CPU tier
+(tiny T, xla impl; the long-T Pallas sweep is a TPU exercise)."""
+
+import io
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, "scripts")
+
+import attn_bench  # noqa: E402
+
+
+def _run(argv):
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        rc = attn_bench.main(argv)
+    finally:
+        sys.stdout = old
+    lines = [
+        ln for ln in buf.getvalue().splitlines() if ln.startswith("{")
+    ]
+    return rc, buf.getvalue(), (json.loads(lines[-1]) if lines else None)
+
+
+def test_json_record_and_args():
+    rc, text, rec = _run(
+        ["--seq-lens", "64,128", "--impls", "xla", "--steps", "1"]
+    )
+    assert rc == 0
+    assert rec["metric"] == "attn_fwd_bwd_ms"
+    assert rec["unit"] == "ms" and rec["value"] > 0
+    rows = rec["detail"]["rows"]
+    assert [r["seq_len"] for r in rows] == [64, 128]
+    assert all(r["impl"] == "xla" for r in rows)
+    assert all("fwd_ms" in r and "fwd_bwd_ms" in r for r in rows)
+    # the human-readable sweep lines still print
+    assert "fwd_bwd" in text
+
+
+def test_xla_skipped_beyond_materialization_limit(monkeypatch):
+    # keep the run tiny: lower the cap instead of running a real 8k+
+    monkeypatch.setattr(attn_bench, "XLA_MAX_T", 64)
+    rc, _text, rec = _run(
+        ["--seq-lens", "128", "--impls", "pallas,xla", "--steps", "1"]
+    )
+    skipped = rec["detail"]["skipped"]
+    assert [s["impl"] for s in skipped] == ["xla"]
+    assert skipped[0]["reason"] == "xla_oom"
+    # but xla alone at the same T still runs (no silent empty sweep)
+    rc2, _t2, rec2 = _run(
+        ["--seq-lens", "128", "--impls", "xla", "--steps", "1"]
+    )
+    assert rc2 == 0 and rec2["detail"]["rows"]
+
+
+def test_bad_args_rejected():
+    with pytest.raises(SystemExit):
+        _run(["--seq-lens", "", "--impls", "xla"])

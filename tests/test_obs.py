@@ -435,14 +435,20 @@ def test_decode_audit_paged_floor_accounts_table_bytes():
         for p, s in traverse_util.flatten_dict(dict(shapes)).items()
         if p[-1] in ("cached_k", "cached_v")
     )
-    view, table = paged_step_bytes(model, 2, 16, block_size=4)
+    view, table, scale = paged_step_bytes(model, 2, 16, block_size=4)
     # block-aligned max_len: the gathered view streams exactly the dense
-    # KV bytes — the floor differs ONLY by the table overhead
+    # KV bytes — the floor differs ONLY by the table overhead (and no
+    # scale bytes exist on the native dtype)
     assert view == dense_kv
     assert table > 0
+    assert scale == 0
     # non-dividing block size: rounding makes the view strictly larger
-    view5, _ = paged_step_bytes(model, 2, 16, block_size=5)
+    view5, _, _ = paged_step_bytes(model, 2, 16, block_size=5)
     assert view5 > dense_kv
+    # int8 mode: payload shrinks, f32 per-head scales appear itemized
+    view8, _, scale8 = paged_step_bytes(model, 2, 16, block_size=4,
+                                        kv_dtype="int8")
+    assert view8 < view and scale8 > 0
     # the row itemizes the table bytes already inside bytes_per_step
     row = sweep_row(2, 100.0, view, view + table, 1000.0, False,
                     table_bytes=table)
