@@ -23,7 +23,8 @@ PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        obs-watch bench-trend accum-memory fault-suite serve-bench native \
+        obs-watch bench-trend accum-memory fault-suite serve-bench \
+        serve-bench-spec native \
         provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -88,8 +89,17 @@ decode-audit:	## decode-tier roofline + batch sweep (round 5; --kv-dtype/
 
 serve-bench:	## continuous batching vs sequential generate under Poisson
 	## load (docs/SERVING.md protocol; SERVE_*/BENCH_VOCAB knobs;
-	## SERVE_KV_DTYPE/SERVE_WEIGHT_DTYPE=int8 run the quant compare)
+	## SERVE_KV_DTYPE/SERVE_WEIGHT_DTYPE=int8 run the quant compare;
+	## SERVE_SPEC_K>0 runs the speculative compare)
 	$(PY) scripts/serve_bench.py
+
+serve-bench-spec:	## speculative-decode compare: greedy vs int8 self-draft
+	## spec engine at K=4 on a decode-heavy backlog — gates bitwise
+	## greedy parity + >=1.4x tokens/sec + closed program sets
+	## (docs/SERVING.md speculative tier; serve_lm_spec recertify row)
+	SERVE_SPEC_K=$(or $(SPEC_K),4) SERVE_SPEC_DRAFT=$(or $(SPEC_DRAFT),int8) \
+	    SERVE_MAX_NEW=64 SERVE_REQUESTS=24 SERVE_RATE_RPS=0 \
+	    SERVE_PREFILLS_PER_STEP=8 $(PY) scripts/serve_bench.py
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
 	$(PY) scripts/accum_memory.py

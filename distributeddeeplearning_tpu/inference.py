@@ -60,7 +60,19 @@ def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0,
     (``ops/quant.py`` — the engine's ``SERVE_KV_DTYPE``). The sequential
     path here always decodes dense/unquantized, so the kwargs are only
     passed through when set (custom models without the fields keep
-    working)."""
+    working).
+
+    **Multi-token decode-verify view** (part of this contract since the
+    speculative tier): the decode clone accepts ``[B, t]`` token windows
+    with *vector* per-row positions, not just ``[B, 1]`` — K/V for all
+    ``t`` positions are written before the gather, each query position
+    masks to exactly its own prefix, and the position-embedding gather
+    follows the same per-row start (``models/vit.Attention`` /
+    ``transformer_lm``). ``SlotEngine``'s batched verify runs the target
+    over ``[num_slots, spec_k + 1]`` through this view; callers must
+    keep ``position + t <= max_len`` (``dynamic_update_slice`` clamps
+    out-of-range starts — the serving engine reserves ``spec_k``
+    headroom at admission for exactly this reason)."""
     kw = {}
     if paged_blocks:
         kw.update(paged_blocks=int(paged_blocks),

@@ -296,7 +296,18 @@ class Attention(nn.Module):
         positions (``serving.SlotEngine``: each batch row is an
         independent request slot at its own depth). The vector path
         writes K/V per row and masks per row; the math per row is
-        identical to the scalar path at that row's position."""
+        identical to the scalar path at that row's position.
+
+        Multi-token windows (``t > 1``) compose with the vector path —
+        the decode-verify view of the speculative tier: row ``b``'s
+        ``t`` K/V rows land at ``idx[b] .. idx[b]+t-1`` BEFORE the
+        gather, and the ``[B, t]`` position grid masks each query to
+        its own prefix, so candidate ``j`` attends the committed
+        context plus candidates ``< j`` exactly. Contract: callers keep
+        ``idx[b] + t <= max_len`` — ``dynamic_update_slice`` clamps an
+        out-of-range start backwards, which would silently overwrite
+        committed rows (the serving engine reserves ``spec_k`` headroom
+        at admission)."""
         from jax import lax
 
         ci = self.variable(

@@ -273,6 +273,19 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
             # engines report the int8 + scale bytes, never just payload.
             "kv_bytes_per_token": gauges.get("serve.kv_bytes_per_token"),
             "param_bytes": gauges.get("serve.param_bytes"),
+            # Speculative tier (spec_k > 0): cumulative accepted /
+            # rejected draft tokens, the last tick's accept rate and
+            # draft/verify wall split. All None/0 without speculation,
+            # which emits none of them.
+            "spec_tokens_accepted": counters.get(
+                "serve.spec_tokens_accepted", 0
+            ),
+            "spec_tokens_rejected": counters.get(
+                "serve.spec_tokens_rejected", 0
+            ),
+            "spec_accept_rate": gauges.get("serve.spec_accept_rate"),
+            "spec_draft_ms": gauges.get("serve.spec_draft_ms"),
+            "spec_verify_ms": gauges.get("serve.spec_verify_ms"),
             "queue_wait": span_stats.get("serve.queue_wait"),
             "ttft": span_stats.get("serve.ttft"),
             "prefill": span_stats.get("serve.prefill"),
@@ -376,6 +389,26 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
                 f"  bytes (dtype-aware): "
                 f"{srv['kv_bytes_per_token']:.0f} B KV/token, "
                 f"params {pb / 2**20:.1f} MiB resident"
+            )
+        # Speculative acceptance line: how many draft tokens the verify
+        # kept vs threw away, cumulative over the run.
+        acc = srv.get("spec_tokens_accepted") or 0
+        rej = srv.get("spec_tokens_rejected") or 0
+        if acc or rej:
+            total = acc + rej
+            add(
+                f"  speculative: {acc:.0f}/{total:.0f} draft tokens "
+                f"accepted ({acc / total:.0%})"
+                + (
+                    f", last tick accept {srv['spec_accept_rate']:.2f}"
+                    if srv.get("spec_accept_rate") is not None else ""
+                )
+                + (
+                    f", draft {srv['spec_draft_ms']:.1f}ms / verify "
+                    f"{srv['spec_verify_ms']:.1f}ms per tick"
+                    if srv.get("spec_draft_ms") is not None
+                    and srv.get("spec_verify_ms") is not None else ""
+                )
             )
         # Per-request latency anatomy: where the time went.
         for label, key in (

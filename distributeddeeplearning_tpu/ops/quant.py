@@ -108,6 +108,16 @@ def quantize_params(params: Any) -> Any:
     from flax.core import unfreeze
 
     flat = traverse_util.flatten_dict(unfreeze(params))
+    if any(path[-1] in (Q8, Q8_SCALE) for path in flat):
+        # Double-quantizing would treat the int8 payload as weights and
+        # re-scale it into garbage. The serving tier guards the one way
+        # this used to be reachable (an int8 self-speculative draft of
+        # an int8-weight target — serving/spec.validate_spec_config);
+        # this keeps the invariant local to the pass itself.
+        raise ValueError(
+            "param tree is already quantized ({_q8, _q8_scale} leaves "
+            "present) — quantize_params is one-shot"
+        )
     out: Dict[Tuple[str, ...], Any] = {}
     for path, leaf in flat.items():
         if _is_quantizable(path, leaf):

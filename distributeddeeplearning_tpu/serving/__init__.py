@@ -22,9 +22,19 @@ accelerator between requests and recompile per prompt length. Here:
   loop — it reads the live plane's rollup snapshot and derates
   admission while a latency SLO burns (docs/SERVING.md).
 
+* Speculative decode tier (``spec_k > 0``, docs/SERVING.md) — a draft
+  source proposes K tokens per slot (int8 self-draft or host-side
+  n-gram prompt lookup, :mod:`~.spec`), one fixed-shape batched verify
+  runs the target over ``[num_slots, K+1]`` positions, and the
+  rejection-sampling rule (:func:`~.sampling.spec_verify_slots`)
+  commits 1..K+1 tokens per slot per tick. Greedy streams stay
+  token-for-token identical to non-speculative decode; sampled streams
+  keep the target's distribution exactly.
+
 Per-request output is **bitwise-identical** to sequential
 ``inference.generate`` (greedy and seeded sampling) whatever the
-co-scheduling — ``tests/test_serving.py`` is the oracle.
+co-scheduling — ``tests/test_serving.py`` is the oracle
+(``tests/test_serving_spec.py`` for the speculative tier).
 """
 
 from distributeddeeplearning_tpu.serving.blocks import (  # noqa: F401
@@ -42,6 +52,10 @@ from distributeddeeplearning_tpu.serving.keys import (  # noqa: F401
 from distributeddeeplearning_tpu.serving.sampling import (  # noqa: F401
     sample_slot,
     sample_slots,
+    spec_verify_slots,
+)
+from distributeddeeplearning_tpu.serving.spec import (  # noqa: F401
+    NgramDrafter,
 )
 from distributeddeeplearning_tpu.serving.scheduler import (  # noqa: F401
     AdaptiveAdmissionPolicy,

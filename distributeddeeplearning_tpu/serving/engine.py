@@ -51,6 +51,12 @@ from distributeddeeplearning_tpu.serving.sampling import (
     DEFAULT_TOP_K_CAP,
     sample_slot,
     sample_slots,
+    spec_verify_slots,
+)
+from distributeddeeplearning_tpu.serving.spec import (
+    NgramDrafter,
+    propose_all,
+    validate_spec_config,
 )
 from distributeddeeplearning_tpu.utils.logging import get_logger
 
@@ -145,6 +151,9 @@ class SlotEngine:
         prefix_cache: bool = True,
         kv_dtype: str = "bf16",
         weight_dtype: str = "bf16",
+        spec_k: int = 0,
+        spec_draft: str = "int8",
+        spec_ngram_n: int = 3,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -163,6 +172,7 @@ class SlotEngine:
                 f"weight_dtype must be 'bf16' or 'int8', got "
                 f"{weight_dtype!r}"
             )
+        validate_spec_config(spec_k, spec_draft, spec_ngram_n, weight_dtype)
         model_max = getattr(model, "max_seq_len", None)
         if max_len is None:
             if model_max is None:
@@ -205,6 +215,25 @@ class SlotEngine:
             self.blocks_per_slot = 0
             self.num_blocks = 0
             self.decode_model = decode_variant(model, **quant_kw)
+        # Speculative decode tier (docs/SERVING.md): spec_k draft
+        # proposals per slot per tick, then ONE fixed-shape batched
+        # verify runs the target over [num_slots, spec_k + 1] positions.
+        # Draft sources: "int8" — greedy self-draft on the quantized
+        # weights (own dense draft KV pool, quantized twin programs);
+        # "ngram" — host-side prompt lookup (serving/spec.py), zero
+        # device cost. Either way acceptance is data and the program
+        # set stays closed (see programs_expected).
+        self.spec_k = int(spec_k)
+        self.spec_draft = spec_draft if self.spec_k else "off"
+        self.spec_ngram_n = int(spec_ngram_n)
+        # The draft decode model is ALWAYS the dense layout (its pool is
+        # private lookahead scratch — block granularity buys nothing);
+        # it follows the engine's kv_dtype so an int8 KV tier quantizes
+        # the draft cache too.
+        self._draft_model = (
+            decode_variant(model, **quant_kw)
+            if self.spec_draft == "int8" else None
+        )
         bs = tuple(sorted(set(int(b) for b in (buckets or default_buckets(max_len)))))
         if not bs or bs[0] < 1:
             raise ValueError(f"invalid bucket ladder {bs}")
@@ -232,6 +261,18 @@ class SlotEngine:
             from distributeddeeplearning_tpu.ops import quant as quantlib
 
             self.params = jax.jit(quantlib.quantize_params)(self.params)
+        # Self-speculative draft weights: the PR-8 int8 tier of the SAME
+        # model — one-shot quantized at build (weight_dtype="int8" is
+        # rejected above for this source, so self.params is the native
+        # tree). The draft programs dequantize on use (_spec_draft_fn),
+        # so draft steps stream the int8 + scale bytes.
+        self._draft_params = None
+        if self.spec_draft == "int8":
+            from distributeddeeplearning_tpu.ops import quant as quantlib
+
+            self._draft_params = jax.jit(quantlib.quantize_params)(
+                self.params
+            )
 
         # Cache pool template: shape-only trace of the decode model's
         # init at [num_slots, max_len] (no parameter initializers run).
@@ -250,6 +291,15 @@ class SlotEngine:
         for path, leaf in self._template.items():
             if path[-1] not in _INDEX_NAMES and leaf.ndim < 2:
                 raise ValueError(f"unexpected cache leaf {path}: {leaf}")
+        # Draft cache template (int8 self-draft): a second dense pool at
+        # the same [num_slots, max_len] geometry, written by the draft
+        # programs only.
+        self._draft_template = (
+            self._flatten(unfreeze(decode_cache_shapes(
+                self._draft_model, self.num_slots, self.max_len
+            )))
+            if self._draft_model is not None else None
+        )
 
         # Host-side slot state (the scheduler-visible mirror of the
         # device pool; positions are re-fed every step, so the device
@@ -264,6 +314,18 @@ class SlotEngine:
         self._eos = np.full(s, -1, np.int32)
         self._ladders: List[Optional[np.ndarray]] = [None] * s
         self._cursor = np.zeros(s, np.int64)
+        # Speculative bookkeeping: the committed token BEFORE the next
+        # input (the draft catch-up pair), the per-slot commit budget
+        # (spec_step clamps multi-token commits to it), and — when a
+        # drafter needs it — the slot's emitted history (prompt +
+        # committed tokens).
+        self._prev_tokens = np.zeros(s, np.int32)
+        self._max_new = np.zeros(s, np.int32)
+        self._history: List[Optional[List[int]]] = [None] * s
+        self._drafter = (
+            NgramDrafter(self.spec_ngram_n)
+            if self.spec_draft == "ngram" else None
+        )
         # Paged bookkeeping: per-slot block table (unused entries point
         # at the trash block 0) and the owned block-id lists.
         self._tables = (
@@ -278,18 +340,29 @@ class SlotEngine:
         self._pool = None
         self._decode_exec = None
         self._prefill_exec: Dict[int, Any] = {}
+        self._draft_pool = None
+        self._spec_verify_exec = None
+        self._spec_draft_exec = None
+        self._spec_draft_prefill_exec: Dict[int, Any] = {}
         self.compile_count = 0
         self.compile_sec = 0.0
         self.decode_steps = 0
+        # Running speculative tallies (serve_bench's accept-rate
+        # percentiles; the serve.spec_* gauges/counters mirror them).
+        self.spec_stats: Dict[str, Any] = {
+            "verify_ticks": 0, "tokens_accepted": 0, "tokens_rejected": 0,
+            "tokens_committed": 0, "draft_s": 0.0, "verify_s": 0.0,
+            "accept_rates": [],
+        }
 
     # -- cache plumbing ----------------------------------------------------
 
-    def _zero_cache(self, batch: int):
+    def _zero_cache(self, batch: int, template=None):
         return self._unflatten({
             path: jnp.zeros(
                 ((batch,) + leaf.shape[1:]) if leaf.ndim else (), leaf.dtype
             )
-            for path, leaf in self._template.items()
+            for path, leaf in (template or self._template).items()
         })
 
     def _with_positions(self, cache, positions, tables=None):
@@ -436,12 +509,159 @@ class SlotEngine:
         }
         return self._unflatten(out), first, eos_hit
 
+    # -- traced programs: speculative tier ---------------------------------
+
+    def _spec_verify_core(self, params, cache, tokens, step_keys, temps,
+                          top_ks, top_ps):
+        """Shared tail of both verify layouts: one [S, K+1] forward of
+        the target (multi-token decode view — per-row positions, writes
+        land at [pos, pos+K], the position mask makes each query attend
+        exactly its own prefix), then the rejection-sampling acceptance
+        (serving/sampling.spec_verify_slots). Rejected-tail K/V writes
+        land beyond the committed cursor and are overwritten by the next
+        tick's writes before any query can attend them — the same
+        trash-tail argument the bucketed prefill already relies on."""
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            train=False,
+            mutable=["cache"],
+        )
+        committed, accepted = spec_verify_slots(
+            logits, tokens[:, 1:], step_keys, temps, top_ks, top_ps,
+            top_k_cap=self.top_k_cap,
+        )
+        return self._unfreeze(mutated["cache"]), committed, accepted
+
+    def _spec_verify_fn(self, params, pool, tokens, positions, step_keys,
+                        temps, top_ks, top_ps):
+        params = self._live_params(params)
+        cache = self._with_positions(pool, positions)
+        return self._spec_verify_core(
+            params, cache, tokens, step_keys, temps, top_ks, top_ps
+        )
+
+    def _spec_verify_paged_fn(self, params, pool, tokens, positions,
+                              tables, step_keys, temps, top_ks, top_ps):
+        """Paged twin: identical math, K/V routed through the block
+        tables (out-of-range lookahead writes land in the trash block;
+        admission reserves ``spec_k`` extra positions so in-range ones
+        stay inside the slot's own blocks — ``blocks_needed``)."""
+        params = self._live_params(params)
+        cache = self._with_positions(pool, positions, tables)
+        return self._spec_verify_core(
+            params, cache, tokens, step_keys, temps, top_ks, top_ps
+        )
+
+    def _spec_draft_fn(self, draft_params, dpool, catchup, positions):
+        """The int8 self-draft phase as ONE program: a [S, 2] catch-up
+        forward (re-feeds the previous committed token and the next
+        input — after an all-accepted tick the draft cache is exactly
+        one position behind, and the 2-wide window closes that gap;
+        otherwise the first write is an idempotent re-write), whose last
+        logits propose draft 1, then a lax.scan of K-1 greedy
+        single-token steps. One dispatch per tick regardless of K. The
+        dequantize runs ONCE per tick, hoisted outside the scan — K
+        back-to-back draft forwards amortize one f32 materialization
+        (decode_audit charges the draft steps at the dequantized bytes
+        plus the resident int8 copy; re-dequantizing per scan step
+        measured ~K× slower on the CPU tier for no byte win)."""
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
+        params = quantlib.dequantize_params(draft_params)
+        cache = self._with_positions(dpool, positions)
+        logits, mutated = self._draft_model.apply(
+            {"params": params, "cache": cache},
+            catchup,
+            train=False,
+            mutable=["cache"],
+        )
+        d1 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        cache = self._unfreeze(mutated["cache"])
+        if self.spec_k == 1:
+            return cache, d1[:, None]
+
+        def body(carry, _):
+            cache, tok = carry
+            # Position counters advance on-device inside the scan (the
+            # cache's index leaves ride the carry); the host only feeds
+            # the start positions. `params` is the hoisted once-per-tick
+            # dequantized view from above.
+            logits, mutated = self._draft_model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                train=False,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (self._unfreeze(mutated["cache"]), nxt), nxt
+
+        (cache, _), rest = lax.scan(
+            body, (cache, d1), None, length=self.spec_k - 1
+        )
+        drafts = jnp.concatenate(
+            [d1[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+        )
+        return cache, drafts
+
+    def _spec_draft_prefill_fn(self, draft_params, dpool, slot, tokens):
+        """Draft-pool prefill (int8 source): the full prompt through the
+        quantized weights into the slot's draft rows — the draft's
+        attention needs its OWN K/V of the prefix (int8-weight K/V
+        differ from the target's). Always the full prompt, even when
+        the target prefill rode a prefix-cache hit."""
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
+        params = quantlib.dequantize_params(draft_params)
+        fresh = self._with_positions(
+            self._zero_cache(1, self._draft_template),
+            jnp.zeros((), jnp.int32),
+        )
+        _, mutated = self._draft_model.apply(
+            {"params": params, "cache": fresh},
+            tokens,
+            train=False,
+            mutable=["cache"],
+        )
+        mflat = self._flatten(self._unfreeze(mutated["cache"]))
+        pflat = self._flatten(self._unfreeze(dpool))
+        out = {
+            path: (
+                lax.dynamic_update_slice(
+                    leaf, mflat[path], (slot,) + (0,) * (leaf.ndim - 1)
+                )
+                if path[-1] not in _INDEX_NAMES
+                else leaf
+            )
+            for path, leaf in pflat.items()
+        }
+        return self._unflatten(out)
+
     # -- compilation -------------------------------------------------------
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self.spec_k > 0
+
+    @property
+    def programs_expected(self) -> int:
+        """The closed program set's static size: decode + one prefill
+        per bucket, plus — speculative tier — the batched verify and,
+        for the int8 self-draft, the draft phase + one draft prefill
+        per bucket. Enlarged but CLOSED: ``compile_count`` equals this
+        for the engine's whole lifetime after :meth:`warmup`."""
+        n = len(self.buckets) + 1
+        if self.spec_enabled:
+            n += 1  # the [S, spec_k+1] verify
+            if self.spec_draft == "int8":
+                n += 1 + len(self.buckets)  # draft phase + draft prefills
+        return n
 
     def warmup(self) -> Dict[str, float]:
         """AOT-compile the decode step and every bucket's prefill
-        (idempotent). After this the engine's program set is closed:
-        ``compile_count == len(buckets) + 1`` for its whole lifetime."""
+        (idempotent) — plus, with speculation on, the verify and draft
+        programs. After this the engine's program set is closed:
+        ``compile_count == programs_expected`` for its whole lifetime."""
         log = get_logger()
         t_all = time.perf_counter()
         if self._pool is None:
@@ -530,6 +750,8 @@ class SlotEngine:
                     )
                 self.compile_sec += time.perf_counter() - t0
             self.compile_count += 1
+        if self.spec_enabled:
+            self._warmup_spec(paged)
         if paged:
             self._emit_pool_gauges()
         acct = self.byte_accounting()
@@ -542,13 +764,85 @@ class SlotEngine:
             "programs": float(self.compile_count),
         }
         log.info(
-            "serve warmup: %d programs (decode + %d prefill buckets %s) "
+            "serve warmup: %d programs (decode + %d prefill buckets %s%s) "
             "in %.2fs, slots=%d cache_len=%d",
             self.compile_count, len(self.buckets), list(self.buckets),
+            (f" + spec k={self.spec_k} draft={self.spec_draft}"
+             if self.spec_enabled else ""),
             time.perf_counter() - t_all, s, self.max_len,
         )
         obs.gauge("serve.programs", float(self.compile_count))
         return info
+
+    def _warmup_spec(self, paged: bool) -> None:
+        """Compile the speculative members of the program set: the
+        [S, K+1] batched verify (dense or paged twin) and — int8 draft —
+        the one-dispatch draft phase plus a draft prefill per bucket."""
+        s, k = self.num_slots, self.spec_k
+        if self._spec_verify_exec is None:
+            with obs.span("compile", what="serve_spec_verify", k=k):
+                t0 = time.perf_counter()
+                args = [
+                    self.params, self._pool,
+                    np.zeros((s, k + 1), np.int32), np.zeros(s, np.int32),
+                ]
+                if paged:
+                    args.append(
+                        np.zeros((s, self.blocks_per_slot), np.int32)
+                    )
+                args += [
+                    np.zeros((s, k + 1, 2), np.uint32),
+                    np.zeros(s, np.float32), np.zeros(s, np.int32),
+                    np.zeros(s, np.float32),
+                ]
+                fn = (
+                    self._spec_verify_paged_fn if paged
+                    else self._spec_verify_fn
+                )
+                self._spec_verify_exec = (
+                    jax.jit(fn, donate_argnums=(1,)).lower(*args).compile()
+                )
+                self.compile_sec += time.perf_counter() - t0
+            self.compile_count += 1
+        if self.spec_draft != "int8":
+            return
+        if self._draft_pool is None:
+            self._draft_pool = jax.device_put(self._unflatten({
+                path: jnp.zeros(
+                    (self.num_slots,) if path[-1] in _INDEX_NAMES
+                    else leaf.shape,
+                    jnp.int32 if path[-1] in _INDEX_NAMES else leaf.dtype,
+                )
+                for path, leaf in self._draft_template.items()
+            }))
+        if self._spec_draft_exec is None:
+            with obs.span("compile", what="serve_spec_draft", k=k):
+                t0 = time.perf_counter()
+                self._spec_draft_exec = (
+                    jax.jit(self._spec_draft_fn, donate_argnums=(1,))
+                    .lower(
+                        self._draft_params, self._draft_pool,
+                        np.zeros((s, 2), np.int32), np.zeros(s, np.int32),
+                    )
+                    .compile()
+                )
+                self.compile_sec += time.perf_counter() - t0
+            self.compile_count += 1
+        for bucket in self.buckets:
+            if bucket in self._spec_draft_prefill_exec:
+                continue
+            with obs.span("compile", what=f"serve_spec_draft_prefill_b{bucket}"):
+                t0 = time.perf_counter()
+                self._spec_draft_prefill_exec[bucket] = (
+                    jax.jit(self._spec_draft_prefill_fn, donate_argnums=(1,))
+                    .lower(
+                        self._draft_params, self._draft_pool,
+                        np.int32(0), np.zeros((1, bucket), np.int32),
+                    )
+                    .compile()
+                )
+                self.compile_sec += time.perf_counter() - t0
+            self.compile_count += 1
 
     # -- slot lifecycle ----------------------------------------------------
 
@@ -585,18 +879,38 @@ class SlotEngine:
             leaf.size * np.dtype(leaf.dtype).itemsize
             for leaf in jax.tree.leaves(self.params)
         )
-        return {
+        out = {
             "kv_pool_bytes": float(kv),
             "kv_bytes_per_token": kv / max(positions, 1),
             "param_bytes": float(param_bytes),
         }
+        # Speculative tier (int8 self-draft): the draft's resident bytes
+        # are itemized, never hidden — a second dense KV pool plus the
+        # quantized weight tree (decode_audit --spec-k charges both).
+        if self.spec_draft == "int8":
+            dkv = sum(
+                int(np.prod(leaf.shape, dtype=np.int64))
+                * np.dtype(leaf.dtype).itemsize
+                for path, leaf in self._draft_template.items()
+                if path[-1] not in _INDEX_NAMES
+            )
+            out["draft_kv_pool_bytes"] = float(dkv)
+            out["draft_param_bytes"] = float(sum(
+                leaf.size * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree.leaves(self._draft_params)
+            ))
+        return out
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         """Physical blocks a request writes: positions 0 ..
         prompt_len + max_new_tokens - 2 (the final sampled token is
-        never fed back, so its K/V is never written)."""
+        never fed back, so its K/V is never written). The speculative
+        tier reserves ``spec_k`` positions MORE: a verify writes K
+        lookahead candidates past the committed cursor, and reserving
+        them keeps those transient writes inside the slot's own blocks
+        instead of thrashing the trash block."""
         return self.allocator.blocks_for_tokens(
-            prompt_len + max_new_tokens - 1
+            prompt_len + max_new_tokens - 1 + self.spec_k
         )
 
     def can_admit(self, spec: "ReqSpec") -> bool:
@@ -643,6 +957,19 @@ class SlotEngine:
         Returns the effective top_k (``top_k >= vocab`` maps to 0 =
         filter off, the reference's clamp — same draw)."""
         spec.validate(self.max_len, self.buckets[-1])
+        if self.spec_enabled:
+            t = int(np.asarray(spec.prompt).shape[-1])
+            if t + spec.max_new_tokens + self.spec_k > self.max_len:
+                # dynamic_update_slice clamps out-of-range starts, so a
+                # verify window spilling past max_len would CORRUPT
+                # earlier rows — the dense analogue of the paged
+                # lookahead reservation.
+                raise ValueError(
+                    f"prompt {t} + max_new_tokens {spec.max_new_tokens} "
+                    f"+ spec_k {self.spec_k} lookahead exceeds the "
+                    f"engine cache length {self.max_len}; shorten the "
+                    "request or build the engine with max_len + spec_k"
+                )
         if self.allocator is not None:
             t = int(np.asarray(spec.prompt).shape[-1])
             worst = self.blocks_needed(t, spec.max_new_tokens)
@@ -679,8 +1006,15 @@ class SlotEngine:
         prompt = np.asarray(spec.prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
         sampled = spec.temperature > 0.0
+        # Speculative ticks consume one key per VERIFY POSITION (cursor
+        # .. cursor+K), so the ladder carries spec_k lookahead rows past
+        # max_new_tokens. The partitionable-threefry split is
+        # prefix-stable in n (serving/keys.py), so rows 0..max_new-1
+        # are unchanged — spec off/on cannot re-key the non-spec path.
         ladder = (
-            keylib.request_key_ladder(spec.key_data(), spec.max_new_tokens)
+            keylib.request_key_ladder(
+                spec.key_data(), spec.max_new_tokens + self.spec_k
+            )
             if sampled
             else None
         )
@@ -715,6 +1049,18 @@ class SlotEngine:
         self._eos[slot] = eos
         self._ladders[slot] = ladder
         self._cursor[slot] = 1
+        if self.spec_enabled:
+            self._max_new[slot] = spec.max_new_tokens
+            self._prev_tokens[slot] = int(prompt[-1])
+            self._history[slot] = [int(x) for x in prompt] + [int(first)]
+            if self.spec_draft == "int8":
+                bucket = self.bucket_for(t)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :t] = prompt
+                self._draft_pool = self._spec_draft_prefill_exec[bucket](
+                    self._draft_params, self._draft_pool, np.int32(slot),
+                    padded,
+                )
         return int(first), bool(eos_hit)
 
     def _prefill_paged(
@@ -804,6 +1150,103 @@ class SlotEngine:
             out.append((i, int(nxt[i]), bool(eos_hit[i])))
         return out
 
+    def spec_step(self) -> List[Tuple[int, List[int], bool]]:
+        """One speculative tick: draft ``spec_k`` proposals per slot,
+        ONE batched verify of the target over ``[num_slots, spec_k+1]``
+        positions, commit per-slot ``1 .. spec_k+1`` tokens. Returns
+        ``[(slot, committed_tokens, eos_hit), ...]`` for occupied slots
+        — each list already clamped to the request's remaining token
+        budget and truncated at eos (the scheduler releases on either).
+        """
+        if not self.spec_enabled:
+            raise RuntimeError("spec_step requires SlotEngine(spec_k > 0)")
+        slots = self.active_slots
+        if not slots:
+            return []
+        s, k = self.num_slots, self.spec_k
+        tokens = np.zeros((s, k + 1), np.int32)
+        tokens[:, 0] = self._tokens
+        t0 = time.perf_counter()
+        if self.spec_draft == "int8":
+            catchup = np.stack(
+                [self._prev_tokens, self._tokens], axis=1
+            ).astype(np.int32)
+            self._draft_pool, drafts = self._spec_draft_exec(
+                self._draft_params, self._draft_pool, catchup,
+                np.maximum(self._positions - 1, 0).astype(np.int32),
+            )
+            drafts = np.asarray(drafts)
+        else:
+            drafts = propose_all(self._drafter, self._history, slots, s, k)
+        draft_s = time.perf_counter() - t0
+        tokens[:, 1:] = drafts
+        step_keys = np.zeros((s, k + 1, 2), np.uint32)
+        for i in slots:
+            ladder = self._ladders[i]
+            if ladder is not None:
+                c = int(self._cursor[i])
+                step_keys[i] = ladder[c:c + k + 1]
+        t1 = time.perf_counter()
+        if self.allocator is not None:
+            self._pool, committed, accepted = self._spec_verify_exec(
+                self.params, self._pool, tokens, self._positions,
+                self._tables, step_keys, self._temps, self._top_ks,
+                self._top_ps,
+            )
+        else:
+            self._pool, committed, accepted = self._spec_verify_exec(
+                self.params, self._pool, tokens, self._positions,
+                step_keys, self._temps, self._top_ks, self._top_ps,
+            )
+        committed = np.asarray(committed)
+        accepted = np.asarray(accepted)
+        verify_s = time.perf_counter() - t1
+        self.decode_steps += 1
+        out: List[Tuple[int, List[int], bool]] = []
+        acc_total = rej_total = commit_total = 0
+        for i in slots:
+            a = int(accepted[i])
+            acc_total += a
+            rej_total += k - a
+            remaining = int(self._max_new[i]) - int(self._cursor[i])
+            n = min(a + 1, remaining)
+            toks = [int(x) for x in committed[i, :n]]
+            eos = int(self._eos[i])
+            eos_hit = False
+            if eos >= 0:
+                for j, tok in enumerate(toks):
+                    if tok == eos:
+                        toks = toks[: j + 1]
+                        eos_hit = True
+                        break
+            n = len(toks)
+            commit_total += n
+            self._prev_tokens[i] = (
+                toks[-2] if n >= 2 else int(self._tokens[i])
+            )
+            self._tokens[i] = toks[-1]
+            self._positions[i] += n
+            self._cursor[i] += n
+            if self._history[i] is not None:
+                self._history[i].extend(toks)
+            out.append((i, toks, eos_hit))
+        st = self.spec_stats
+        st["verify_ticks"] += 1
+        st["tokens_accepted"] += acc_total
+        st["tokens_rejected"] += rej_total
+        st["tokens_committed"] += commit_total
+        st["draft_s"] += draft_s
+        st["verify_s"] += verify_s
+        rate = acc_total / max(len(slots) * k, 1)
+        if len(st["accept_rates"]) < 100_000:
+            st["accept_rates"].append(rate)
+        obs.gauge("serve.spec_accept_rate", rate)
+        obs.gauge("serve.spec_draft_ms", draft_s * 1e3)
+        obs.gauge("serve.spec_verify_ms", verify_s * 1e3)
+        obs.counter("serve.spec_tokens_accepted", acc_total)
+        obs.counter("serve.spec_tokens_rejected", rej_total)
+        return out
+
     def force_token(self, slot: int, token: int) -> None:
         """Teacher-forcing hook for quality oracles (serve_bench's
         quantization compare, ``tests/test_serving_quant.py``): override
@@ -832,6 +1275,9 @@ class SlotEngine:
         self._top_ps[slot] = 0.0
         self._eos[slot] = -1
         self._cursor[slot] = 0
+        self._prev_tokens[slot] = 0
+        self._max_new[slot] = 0
+        self._history[slot] = None
         if self.allocator is not None:
             for bid in self._slot_blocks[slot]:
                 self.allocator.decref(bid)

@@ -56,26 +56,21 @@ def _draw(out, key, temperature, greedy):
     return jnp.where(temperature > 0, sampled, greedy)
 
 
-def _row_topk(logits, key, temperature, top_k, top_k_cap):
-    """Sort-free row sampler (greedy / top-k): threshold from
-    ``lax.top_k`` at the static cap — same k-th *value* as a sort."""
+def _filter_topk(scaled, top_k, top_k_cap):
+    """Sort-free filter (greedy / top-k): threshold from ``lax.top_k``
+    at the static cap — same k-th *value* as a sort."""
     neg_inf = jnp.finfo(jnp.float32).min
-    greedy = jnp.argmax(logits).astype(jnp.int32)
-    scaled = _scale(logits, temperature)
     cap = min(top_k_cap, scaled.shape[-1])
     top_vals = lax.top_k(scaled, cap)[0]
     kth = top_vals[jnp.clip(top_k, 1, cap) - 1]
-    out = jnp.where(top_k > 0, jnp.where(scaled < kth, neg_inf, scaled),
-                    scaled)
-    return _draw(out, key, temperature, greedy)
+    return jnp.where(top_k > 0, jnp.where(scaled < kth, neg_inf, scaled),
+                     scaled)
 
 
-def _row_full(logits, key, temperature, top_k, top_p):
-    """Full-sort row sampler (any config, needed once nucleus filtering
-    is in play): one descending sort serves both filters."""
+def _filter_full(scaled, top_k, top_p):
+    """Full-sort filter (any config, needed once nucleus filtering is
+    in play): one descending sort serves both filters."""
     neg_inf = jnp.finfo(jnp.float32).min
-    greedy = jnp.argmax(logits).astype(jnp.int32)
-    scaled = _scale(logits, temperature)
     vocab = scaled.shape[-1]
     sorted_desc = jnp.sort(scaled)[::-1]
     kth = sorted_desc[jnp.clip(top_k, 1, vocab) - 1]
@@ -88,7 +83,20 @@ def _row_full(logits, key, temperature, top_k, top_p):
     keep_sorted = (cum - probs) < top_p
     threshold = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf))
     filtered_p = jnp.where(out < threshold, neg_inf, out)
-    out = jnp.where(top_p > 0, filtered_p, out)
+    return jnp.where(top_p > 0, filtered_p, out)
+
+
+def _row_topk(logits, key, temperature, top_k, top_k_cap):
+    """Sort-free row sampler (greedy / top-k)."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    out = _filter_topk(_scale(logits, temperature), top_k, top_k_cap)
+    return _draw(out, key, temperature, greedy)
+
+
+def _row_full(logits, key, temperature, top_k, top_p):
+    """Full-sort row sampler (any config)."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    out = _filter_full(_scale(logits, temperature), top_k, top_p)
     return _draw(out, key, temperature, greedy)
 
 
@@ -122,3 +130,119 @@ def sample_slots(logits, keys, temperatures, top_ks, top_ps,
             lambda l, k, t, tk: _row_topk(l, k, t, tk, top_k_cap)
         )(logits, keys, temperatures, top_ks),
     )
+
+
+# ---------------------------------------------------------------------------
+# Speculative verify (docs/SERVING.md): rejection-sampling acceptance
+# ---------------------------------------------------------------------------
+#
+# Both draft sources (int8 greedy self-draft, n-gram prompt lookup) are
+# DETERMINISTIC proposers — the draft distribution q is a point mass at
+# the proposed token. The standard speculative-sampling rule (Leviathan
+# et al.; Chen et al.) then specialises to the prompt-lookup form:
+#
+#     accept d with probability min(1, p(d)/q(d)) = p(d);
+#     on rejection, sample from norm(max(0, p - q)) = p with d masked
+#     out (renormalised); if every draft is accepted, draw one bonus
+#     token from the last position's p.
+#
+# Marginally P(x) = [x==d]·p(d) + (1-p(d))·p(x)(1-[x==d])/(1-p(d)) =
+# p(x): the output distribution is EXACTLY the target's, whatever the
+# proposals (tests/test_serving_spec.py pins it with a chi-squared
+# bound against inference._sample). For greedy slots (temperature <= 0)
+# the rule degenerates to argmax equality, so the committed stream is
+# the target's greedy chain token for token.
+
+
+def _spec_row(logits_row, drafts_row, keys_row, temperature, top_k,
+              top_p, top_k_cap):
+    """One slot's verify: ``[K+1, vocab]`` target logits (position j
+    conditioned on the committed context + drafts ``< j``), ``[K]``
+    proposed tokens, ``[K+1, 2]`` per-position keys. Returns
+    ``(committed [K+1], accepted_drafts scalar)`` — entries past
+    ``accepted + 1`` are padding the caller never reads.
+
+    Each position's key splits into two independent sub-draws
+    (``fold_in`` 0/1): the acceptance uniform and the residual/bonus
+    categorical — a rejected position's unused draws may share a key
+    with a later tick's fresh draws at the same output index, which is
+    statistically inert because no committed token ever depended on
+    them."""
+    k = drafts_row.shape[0]
+    neg_inf = jnp.finfo(jnp.float32).min
+    greedy = jnp.argmax(logits_row, axis=-1).astype(jnp.int32)  # [K+1]
+    filt = jax.vmap(
+        lambda l: lax.cond(
+            top_p > 0,
+            lambda: _filter_full(_scale(l, temperature), top_k, top_p),
+            lambda: _filter_topk(_scale(l, temperature), top_k, top_k_cap),
+        )
+    )(logits_row)  # [K+1, vocab] f32, -inf where filtered
+    probs = jax.nn.softmax(filt, axis=-1)
+    p_draft = jnp.take_along_axis(
+        probs[:k], drafts_row[:, None], axis=-1
+    )[:, 0]  # [K] target prob of each proposal
+    u = jax.vmap(
+        lambda kk: jax.random.uniform(jax.random.fold_in(kk, 0))
+    )(keys_row[:k])
+    accept = jnp.where(temperature > 0, u < p_draft, drafts_row == greedy[:k])
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32))).astype(jnp.int32)
+    # Residual (positions < K: this proposal masked out, implicit
+    # renormalisation in the categorical) / bonus (position K, unmasked)
+    # draws at EVERY position; index a selects the one that commits.
+    # The [1, vocab] operand shape mirrors _draw's per-lane bits.
+    vocab_ids = jnp.arange(filt.shape[-1])[None, :]
+    mask_tok = jnp.concatenate(
+        [drafts_row, jnp.full((1,), -1, jnp.int32)]
+    )  # -1 never matches a vocab id: the bonus row stays unmasked
+    res = jnp.where(vocab_ids == mask_tok[:, None], neg_inf, filt)
+    draws = jax.vmap(
+        lambda kk, l: jax.random.categorical(
+            jax.random.fold_in(kk, 1), l[None, :], axis=-1
+        )[0].astype(jnp.int32)
+    )(keys_row, res)
+    final = jnp.where(temperature > 0, draws, greedy)  # [K+1]
+    idx = jnp.arange(k + 1)
+    pad_drafts = jnp.concatenate([drafts_row, jnp.zeros((1,), jnp.int32)])
+    committed = jnp.where(
+        idx < a, pad_drafts, jnp.where(idx == a, final, 0)
+    )
+    return committed, a
+
+
+def spec_verify_slots(logits, drafts, keys, temperatures, top_ks, top_ps,
+                      top_k_cap: int = DEFAULT_TOP_K_CAP):
+    """Vectorised speculative verify over the slot axis.
+
+    ``logits`` ``[S, K+1, vocab]`` (the batched verify forward over
+    ``[committed_next, d_1 .. d_K]``), ``drafts`` ``[S, K]``, ``keys``
+    ``[S, K+1, 2]``, per-slot configs ``[S]``. Returns
+    ``(committed [S, K+1] int32, accepted [S] int32)`` — slot ``i``
+    commits ``accepted[i] + 1`` tokens this tick (1 when every draft is
+    rejected, K+1 when all are accepted plus the bonus token).
+
+    The batch-level cond keeps ALL sampling machinery (softmax over
+    K+1 positions, acceptance uniforms, residual categoricals) out of
+    the program whenever every occupied slot is greedy — the serve
+    bench's regime, where the verify reduces to one argmax + compare.
+    """
+    k = drafts.shape[1]
+
+    def greedy_all():
+        choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+        acc = (drafts == choice[:, :k]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(acc, axis=1), axis=1).astype(jnp.int32)
+        idx = jnp.arange(k + 1)[None, :]
+        pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+        committed = jnp.where(
+            idx < a[:, None], pad, jnp.where(idx == a[:, None], choice, 0)
+        )
+        return committed, a
+
+    def mixed():
+        return jax.vmap(
+            lambda l, d, kk, t, tk, tp: _spec_row(l, d, kk, t, tk, tp,
+                                                  top_k_cap)
+        )(logits, drafts, keys, temperatures, top_ks, top_ps)
+
+    return lax.cond(jnp.any(temperatures > 0), mixed, greedy_all)

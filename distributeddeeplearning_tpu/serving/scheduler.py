@@ -36,6 +36,8 @@ visible in the event stream (``serve.admission_derate`` /
 Env contract (``ServeConfig.from_env``; docs/ORCHESTRATION.md):
 ``SERVE_SLOTS``, ``SERVE_BUCKETS``, ``SERVE_QUEUE_DEPTH``,
 ``SERVE_DEADLINE_MS``, ``SERVE_PREFILLS_PER_STEP``,
+``SERVE_SPEC_K`` / ``SERVE_SPEC_DRAFT`` / ``SERVE_SPEC_NGRAM_N``
+(speculative tier — a tick then commits 1..K+1 tokens per slot),
 ``SERVE_ADMISSION_POLICY`` (``static`` | ``adaptive``),
 ``SERVE_ROLLUP_PATH`` (default ``$OBS_DIR/rollup.json``).
 """
@@ -212,6 +214,14 @@ class ServeConfig:
     # kv_layout — the paged pool quantizes too.
     kv_dtype: str = "bf16"
     weight_dtype: str = "bf16"
+    # Speculative decode tier (docs/SERVING.md): spec_k > 0 turns every
+    # scheduler tick into draft-K-then-verify — 1..K+1 tokens committed
+    # per slot per tick. spec_draft picks the proposal source ("int8" =
+    # quantized self-draft, "ngram" = host-side prompt lookup with
+    # spec_ngram_n match order, "off" only valid with spec_k == 0).
+    spec_k: int = 0
+    spec_draft: str = "int8"
+    spec_ngram_n: int = 3
     # Telemetry feedback (docs/SERVING.md): "static" = fixed admission;
     # "adaptive" = derate while a latency SLO burns, reading the live
     # plane's rollup snapshot (rollup_path; None = $OBS_DIR/rollup.json).
@@ -244,6 +254,9 @@ class ServeConfig:
             ) not in ("0", "false", "off"),
             kv_dtype=str(e.get("SERVE_KV_DTYPE", cls.kv_dtype)),
             weight_dtype=str(e.get("SERVE_WEIGHT_DTYPE", cls.weight_dtype)),
+            spec_k=int(e.get("SERVE_SPEC_K", cls.spec_k)),
+            spec_draft=str(e.get("SERVE_SPEC_DRAFT", cls.spec_draft)),
+            spec_ngram_n=int(e.get("SERVE_SPEC_NGRAM_N", cls.spec_ngram_n)),
             admission_policy=str(
                 e.get("SERVE_ADMISSION_POLICY", cls.admission_policy)
             ),
@@ -272,6 +285,11 @@ class ServeConfig:
                 block_size=self.block_size,
                 num_blocks=self.num_blocks or None,
                 prefix_cache=self.prefix_cache,
+            )
+        if self.spec_k:
+            kw.update(
+                spec_k=self.spec_k, spec_draft=self.spec_draft,
+                spec_ngram_n=self.spec_ngram_n,
             )
         return kw
 
@@ -555,19 +573,31 @@ class Server:
         )
         if self._by_slot:
             with obs.span("serve.decode_step", active=len(self._by_slot)):
-                emitted = self.engine.decode_step()
+                # Speculative tier: one tick commits 1..spec_k+1 tokens
+                # per slot (draft + batched verify); the non-spec step
+                # is the single-token special case of the same shape.
+                if self.engine.spec_enabled:
+                    emitted = self.engine.spec_step()
+                else:
+                    emitted = [
+                        (slot, [token], eos_hit)
+                        for slot, token, eos_hit in
+                        self.engine.decode_step()
+                    ]
             self.stats["decode_steps"] += 1
-            for slot, token, eos_hit in emitted:
+            n_tokens = 0
+            for slot, toks, eos_hit in emitted:
                 h = self._by_slot.get(slot)
                 if h is None:
                     continue
-                h.new_tokens.append(token)
-                self.stats["tokens"] += 1
+                h.new_tokens.extend(toks)
+                self.stats["tokens"] += len(toks)
+                n_tokens += len(toks)
                 if eos_hit or len(h.new_tokens) >= h.request.max_new_tokens:
                     self.engine.release(slot)
                     del self._by_slot[slot]
                     self._finish(h, "eos" if eos_hit else "length")
-            obs.counter("serve.tokens", len(emitted))
+            obs.counter("serve.tokens", n_tokens)
         with self._lock:
             busy = bool(self._by_slot or self._queue)
         if busy:

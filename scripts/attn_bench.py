@@ -11,10 +11,17 @@ Usage::
 
     python scripts/attn_bench.py [--seq-lens 8192,32768]
         [--impls pallas,xla] [--batch 1] [--heads 8] [--head-dim 128]
-        [--steps 5]
+        [--steps 5] [--decode-verify K]
 
 The xla impl materializes the [T, T] score matrix, so it is skipped
 above 8k (OOM) unless it is the only impl requested.
+
+``--decode-verify K`` adds the serving tier's speculative-verify shape
+to the sweep: a ``[B, K+1]`` query window against the full static KV
+cache with per-row position masks — the attention view
+(``models/vit.Attention._masked_decode_scores``) every ``SlotEngine``
+spec tick runs (docs/SERVING.md). Forward-only (an inference path);
+failures are captured per row like the impl sweep.
 """
 
 from __future__ import annotations
@@ -89,6 +96,56 @@ def bench(impl: str, t: int, b: int = 1, h: int = 8, d: int = 128,
     return row
 
 
+def bench_decode_verify(t: int, k: int, b: int = 1, h: int = 8,
+                        d: int = 128, steps: int = 5) -> dict:
+    """One decode-verify timing: a [B, K+1, H, D] query window against
+    a [B, T, H, D] cache view, position-masked per row — the math of
+    ``models/vit.Attention._masked_decode_scores`` at the serving
+    tier's speculative-verify shape. Failures are recorded, not raised,
+    like :func:`bench`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    window = k + 1
+    q = jnp.asarray(rng.randn(b, window, h, d), jnp.bfloat16)
+    k_all = jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
+    v_all = jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
+    # per-row window start: queries sit at the cache's tail
+    pos = jnp.full((b,), t - window, jnp.int32)[:, None] + jnp.arange(window)
+
+    def fwd(q, k_all, v_all, q_pos):
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", (q * d ** -0.5), k_all
+        ).astype(jnp.float32)
+        k_pos = jnp.arange(t)
+        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
+    row = {"impl": "decode_verify", "seq_len": t, "batch": b, "heads": h,
+           "head_dim": d, "window": window}
+    try:
+        fn = jax.jit(fwd)
+        out = fn(q, k_all, v_all, pos)
+        float(jnp.asarray(out).ravel()[0].astype(jnp.float32))  # fence
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, k_all, v_all, pos)
+        float(jnp.asarray(out).ravel()[0].astype(jnp.float32))
+        ms = (time.perf_counter() - t0) / steps * 1e3
+        row["fwd_ms"] = round(ms, 2)
+        print(f"verify  T={t:6d} fwd      {ms:9.1f} ms (window {window})",
+              flush=True)
+    except Exception as e:
+        row["fwd_error"] = f"{type(e).__name__}: {e}"
+        print(f"verify  T={t:6d} fwd      FAILED: "
+              f"{type(e).__name__}: {e}", flush=True)
+    return row
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seq-lens", default="8192,32768",
@@ -101,6 +158,9 @@ def main(argv=None) -> int:
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--steps", type=int, default=5,
                    help="timed calls per configuration")
+    p.add_argument("--decode-verify", type=int, default=0, metavar="K",
+                   help="also time the [B, K+1]-window decode-verify "
+                        "view at each T (0 = off)")
     args = p.parse_args(argv)
     seq_lens = [int(t) for t in args.seq_lens.split(",") if t.strip()]
     impls = [i.strip() for i in args.impls.split(",") if i.strip()]
@@ -120,6 +180,11 @@ def main(argv=None) -> int:
                 continue
             rows.append(bench(impl, t, b=args.batch, h=args.heads,
                               d=args.head_dim, steps=args.steps))
+        if args.decode_verify > 0:
+            rows.append(bench_decode_verify(
+                t, args.decode_verify, b=args.batch, h=args.heads,
+                d=args.head_dim, steps=args.steps,
+            ))
     # Headline: the fwd+bwd ms of the last successful row (the largest
     # T of the preferred impl — what the train step pays per step).
     timed = [r for r in rows if "fwd_bwd_ms" in r]

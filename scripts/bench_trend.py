@@ -10,10 +10,12 @@ regression. Records now carry a ``tier`` (``bench.py``): ``"cpu"`` =
 relay down, protocol re-run on the CPU fallback; ``"outage"`` = nothing
 could run. Neither is comparable to a TPU round, so both are **listed
 but skipped** — as are legacy outage records (``error`` / value ≤ 0
-with no tier), cross-platform pairs, and pairs whose
+with no tier), cross-platform pairs, pairs whose
 ``kv_dtype``/``weight_dtype`` changed (a re-quantized protocol is a new
 baseline, not a regression; records predating the quantized tier count
-as the native "bf16" config).
+as the native "bf16" config), and pairs whose ``spec_k`` changed (a
+re-speculated protocol likewise — records predating the speculative
+tier count as ``spec_k=0``).
 
 A drop > ``--threshold`` (default 10%) between *consecutive comparable*
 records of the same metric+platform exits nonzero — the CI tripwire
@@ -112,6 +114,11 @@ def analyze(
                 detail.get("kv_dtype") or "bf16",
                 detail.get("weight_dtype") or "bf16",
             ),
+            # A spec_k change re-shapes the whole protocol (draft +
+            # verify programs, commits per tick) — a new baseline, not
+            # a regression; records predating the speculative tier ran
+            # spec_k=0 and stay comparable. Same treatment as dtypes.
+            "spec_k": int(detail.get("spec_k") or 0),
             "skip": skip,
             "delta_pct": None,
         }
@@ -123,6 +130,7 @@ def analyze(
                 prev is not None
                 and prev["platform"] == row["platform"]
                 and prev["dtypes"] == row["dtypes"]
+                and prev["spec_k"] == row["spec_k"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -139,15 +147,24 @@ def analyze(
                 row["skip"] = (
                     f"platform_change:{prev['platform']}->{row['platform']}"
                 )
-            elif prev is not None:
+            elif prev is not None and prev["dtypes"] != row["dtypes"]:
                 row["skip"] = (
                     f"dtype_change:{'/'.join(prev['dtypes'])}"
                     f"->{'/'.join(row['dtypes'])}"
                 )
-            if row["skip"] is None:
+            elif prev is not None:
+                row["skip"] = (
+                    f"spec_change:k={prev['spec_k']}->k={row['spec_k']}"
+                )
+            if row["skip"] is None or "_change" in str(row["skip"]):
+                # A protocol/platform transition row is not COMPARED,
+                # but it IS the new baseline — otherwise one permanent
+                # dtype/spec change would skip every later round forever
+                # and the sentinel would go blind for that metric.
                 last[metric] = {
                     "round": e["round"], "value": value,
                     "platform": row["platform"], "dtypes": row["dtypes"],
+                    "spec_k": row["spec_k"],
                 }
         rows.append(row)
     return {

@@ -29,6 +29,21 @@ int32 block tables the gather indexes through. Leaving the table bytes
 out would overstate ``pct_of_floor`` in paged mode; they are itemized as
 ``block_table_bytes`` in each row.
 
+**Speculative mode** (``--spec-k K`` [``--spec-draft int8|ngram``], the
+serving tier's ``SERVE_SPEC_K`` — docs/SERVING.md): every surviving
+byte buys MORE than one token. A verify tick streams the target's
+params + cache ONCE for K+1 candidate positions and commits
+``1..K+1`` tokens, so the audited unit becomes **bytes per accepted
+token** (tick bytes ÷ measured commits per verify) and the rows carry a
+``floor_multiplier`` against the non-speculative floor. The draft's
+costs are itemized honestly, never netted out: the int8 self-draft adds
+a second dense KV pool (``draft_cache_mb``) streamed once per draft
+step, the resident int8+scale weight tree read once per tick, and K
+reads of the dequantized (native-dtype) weight view the draft scan
+hoists (``serving/engine._spec_draft_fn``); the n-gram draft adds
+nothing. The accept rate is MEASURED through a real speculative
+``SlotEngine`` loop, not assumed.
+
 **Quantized mode** (``--kv-dtype int8`` / ``--weight-dtype int8``, the
 serving tier's ``SERVE_KV_DTYPE``/``SERVE_WEIGHT_DTYPE``): the floor is
 recomputed from the bytes the quantized programs actually stream — int8
@@ -46,6 +61,7 @@ Usage::
         [--new-tokens 128] [--batches 1,2,4,8,16,32,64]
         [--kv-layout dense|paged] [--block-size 16]
         [--kv-dtype bf16|int8] [--weight-dtype bf16|int8]
+        [--spec-k 4] [--spec-draft int8|ngram]
         [--profile-dir /tmp/decode_trace]
 
 Prints a per-batch table and ONE summary JSON line.
@@ -211,10 +227,63 @@ def measure_engine(model, params, b: int, prompt_len: int, new_tokens: int,
     return total / t_meas
 
 
+def measure_engine_spec(model, params, b: int, prompt_len: int,
+                        new_tokens: int, vocab: int, reps: int = 3, *,
+                        spec_k: int = 4, spec_draft: str = "int8",
+                        kv_dtype: str = "bf16"):
+    """Measured speculative throughput: ``b`` greedy requests
+    co-resident in a spec SlotEngine, timing the draft+verify ticks to
+    completion. Returns ``(tokens/sec, accept_rate, commits_per_verify)``
+    — the accept rate is what the analytic bytes-per-accepted-token
+    figure divides by, so it is measured, never assumed."""
+    from distributeddeeplearning_tpu.serving import ReqSpec, SlotEngine
+
+    max_len = prompt_len + new_tokens + spec_k  # verify lookahead headroom
+    engine = SlotEngine(
+        model, params, num_slots=b, max_len=max_len,
+        buckets=(prompt_len,), kv_dtype=kv_dtype,
+        spec_k=spec_k, spec_draft=spec_draft,
+    )
+    engine.warmup()
+    rng = np.random.RandomState(0)
+    total = t_meas = 0.0
+    for rep in range(reps + 1):  # rep 0 = warmup, untimed
+        for slot in list(engine.active_slots):
+            engine.release(slot)
+        for slot in range(b):
+            spec = ReqSpec(
+                prompt=rng.randint(0, vocab, size=(prompt_len,)).astype(
+                    np.int32
+                ),
+                max_new_tokens=new_tokens,
+            )
+            engine.validate_spec(spec)
+            engine.prefill(slot, spec)
+        t0 = time.perf_counter()
+        tokens = 0
+        while engine.active_slots:
+            for slot, toks, _eos in engine.spec_step():
+                tokens += len(toks)
+                if engine._cursor[slot] >= engine._max_new[slot]:
+                    engine.release(slot)
+        dt = time.perf_counter() - t0
+        if rep:
+            total += tokens
+            t_meas += dt
+    st = engine.spec_stats
+    proposed = st["tokens_accepted"] + st["tokens_rejected"]
+    accept_rate = st["tokens_accepted"] / max(proposed, 1)
+    commits_per_verify = (
+        st["tokens_committed"] * spec_k / max(proposed, 1)
+    )
+    return total / t_meas, accept_rate, commits_per_verify
+
+
 def audit(model_name: str, prompt_len: int, new_tokens: int,
           batches, profile_dir=None, vocab: int = 32000,
           kv_layout: str = "dense", block_size: int = 16,
-          kv_dtype: str = "bf16", weight_dtype: str = "bf16"):
+          kv_dtype: str = "bf16", weight_dtype: str = "bf16",
+          spec_k: int = 0, spec_draft: str = "int8"):
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -224,9 +293,13 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
     from distributeddeeplearning_tpu.models import get_model
 
     max_len = prompt_len + new_tokens
-    model = get_model(model_name, num_classes=vocab, max_seq_len=max_len)
+    # Speculative rows write spec_k lookahead positions past the last
+    # token — the model (and the spec engine's cache) carries the
+    # headroom; non-spec paths keep auditing the max_len view.
+    model_len = max_len + spec_k
+    model = get_model(model_name, num_classes=vocab, max_seq_len=model_len)
     variables = jax.jit(model.init, static_argnames=("train",))(
-        jax.random.PRNGKey(0), jnp.zeros((1, max_len), jnp.int32),
+        jax.random.PRNGKey(0), jnp.zeros((1, model_len), jnp.int32),
         train=False,
     )
     params = nn.unbox(variables["params"])
@@ -252,10 +325,10 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
     # int8 mode's f32 scale buffers come back itemized.
     decode_model = decode_variant(model, kv_dtype=kv_dtype)
 
-    def cache_byte_split(b: int):
+    def cache_byte_split(b: int, length: int = max_len):
         shapes = jax.eval_shape(
             lambda r: decode_model.init(
-                r, jnp.zeros((b, max_len), jnp.int32), train=False
+                r, jnp.zeros((b, length), jnp.int32), train=False
             ),
             jax.random.PRNGKey(0),
         )["cache"]
@@ -286,6 +359,61 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
 
     for i, b in enumerate(batches):
         table_bytes = scale_bytes = 0
+        if spec_k:
+            # Speculative rows: the audited unit is bytes per ACCEPTED
+            # token — one verify tick's streamed bytes over the
+            # measured commits per verify. The cache view carries the
+            # spec_k lookahead positions the verify writes into.
+            kv, scale_bytes = cache_byte_split(b, max_len + spec_k)
+            verify_bytes = param_bytes + kv + scale_bytes
+            draft_cache = draft_resident = 0
+            if spec_draft == "int8":
+                from distributeddeeplearning_tpu.ops import (
+                    quant as quantlib,
+                )
+
+                dsplit = quantlib.tree_byte_split(
+                    jax.eval_shape(quantlib.quantize_params, params)
+                )
+                draft_resident = (
+                    dsplit["int8"] + dsplit["scale"] + dsplit["other"]
+                )
+                dkv, dkv_scale = cache_byte_split(b, max_len + spec_k)
+                draft_cache = dkv + dkv_scale
+            native_bytes = tree_bytes(params)
+            draft_tick = (
+                draft_resident + spec_k * (native_bytes + draft_cache)
+                if spec_draft == "int8" else 0
+            )
+            bytes_per_tick = verify_bytes + draft_tick
+            tps, accept_rate, commits = measure_engine_spec(
+                model, params, b, prompt_len, new_tokens, vocab,
+                spec_k=spec_k, spec_draft=spec_draft, kv_dtype=kv_dtype,
+            )
+            commits = max(commits, 1e-9)
+            floor = b * commits * HBM_GBPS * 1e9 / bytes_per_tick
+            base_kv, base_scale = cache_byte_split(b)
+            base_bytes = param_bytes + base_kv + base_scale
+            row = sweep_row(b, tps, kv, bytes_per_tick, floor, on_tpu,
+                            kv_scale_bytes=scale_bytes)
+            row.update({
+                "spec_k": spec_k,
+                "accept_rate": round(accept_rate, 4),
+                "commits_per_verify": round(commits, 2),
+                "bytes_per_accepted_token_mb": round(
+                    bytes_per_tick / (b * commits) / 2**20, 2
+                ),
+                "draft_cache_mb": round(draft_cache / 2**20, 1),
+                "draft_param_mb": round(draft_resident / 2**20, 1),
+                # tokens a surviving byte buys vs the non-spec floor
+                "floor_multiplier": round(
+                    commits * base_bytes / bytes_per_tick, 2
+                ),
+            })
+            rows.append(row)
+            print(format_row(row) + f"  x{row['floor_multiplier']:.2f} "
+                  f"floor (accept {accept_rate:.2f})", flush=True)
+            continue
         if kv_layout == "paged":
             kv, table_bytes, scale_bytes = paged_step_bytes(
                 model, b, max_len, block_size, kv_dtype
@@ -357,6 +485,9 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
         out["param_scale_bytes"] = int(param_scale_bytes)
     if kv_layout == "paged":
         out["block_size"] = block_size
+    if spec_k:
+        out["spec_k"] = spec_k
+        out["spec_draft"] = spec_draft
     return out
 
 
@@ -377,13 +508,24 @@ def main(argv=None) -> int:
     p.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16")
     p.add_argument("--weight-dtype", choices=("bf16", "int8"),
                    default="bf16")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative lookahead (0 = off); rows become "
+                        "bytes per ACCEPTED token at the measured "
+                        "accept rate")
+    p.add_argument("--spec-draft", choices=("int8", "ngram"),
+                   default="int8")
     p.add_argument("--profile-dir", default=None)
     args = p.parse_args(argv)
+    if args.spec_k and (args.kv_layout == "paged"
+                        or args.weight_dtype == "int8"):
+        p.error("--spec-k rows audit the dense native-weight engine "
+                "(the serving tier's spec-compare regime)")
     batches = [int(b) for b in args.batches.split(",") if b.strip()]
     out = audit(args.model, args.prompt_len, args.new_tokens, batches,
                 profile_dir=args.profile_dir, vocab=args.vocab,
                 kv_layout=args.kv_layout, block_size=args.block_size,
-                kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
+                kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+                spec_k=args.spec_k, spec_draft=args.spec_draft)
     print(json.dumps(out))
     return 0
 

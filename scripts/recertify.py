@@ -22,6 +22,9 @@ Protocols (all via bench.py's existing modes — no new measurement code):
     serve_lm_int8   serve_bench bf16-vs-int8 (KV +     tokens/sec
                     weights) at a fixed byte budget,
                     teacher-forced match-rate oracle
+    serve_lm_spec   serve_bench greedy-vs-speculative  tokens/sec
+                    (int8 self-draft, K=4), bitwise
+                    greedy parity + accept-rate stats
 
 Usage::
 
@@ -105,6 +108,21 @@ PROTOCOLS = {
         "SERVE_REQUESTS": "48", "SERVE_RATE_RPS": "0",
         "SERVE_POOL_SLOT_BUDGET": "4", "SERVE_PREFILLS_PER_STEP": "4",
     },
+    # Speculative decode tier (docs/SERVING.md): plain greedy vs the
+    # int8 self-draft speculative engine on a decode-heavy closed
+    # backlog — the row's JSON line carries both runs, the accept-rate
+    # p50/mean and draft/verify time split, and the script exits
+    # non-zero unless spec tokens/sec >= 1.4x the greedy baseline with
+    # BITWISE greedy parity, zero mid-measure recompiles, and both
+    # program sets closed at their static counts.
+    "serve_lm_spec": {
+        "_script": "scripts/serve_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_SPEC_K": "4", "SERVE_SPEC_DRAFT": "int8",
+        "SERVE_PROFILE": "mixed", "SERVE_MAX_NEW": "64",
+        "SERVE_REQUESTS": "24", "SERVE_RATE_RPS": "0",
+        "SERVE_SLOTS": "8", "SERVE_PREFILLS_PER_STEP": "8",
+    },
 }
 
 
@@ -122,6 +140,8 @@ _PROTOCOL_VARS = (
     "SERVE_KV_LAYOUT", "SERVE_PROFILE", "SERVE_BLOCK_SIZE",
     "SERVE_NUM_BLOCKS", "SERVE_PREFIX_CACHE", "SERVE_POOL_SLOT_BUDGET",
     "SERVE_KV_DTYPE", "SERVE_WEIGHT_DTYPE", "SERVE_QUANT_MATCH_MIN",
+    "SERVE_SPEC_K", "SERVE_SPEC_DRAFT", "SERVE_SPEC_NGRAM_N",
+    "SERVE_SPEC_MIN_SPEEDUP",
 )
 
 

@@ -41,6 +41,16 @@ requests is served by up to three configurations:
   note). Exits non-zero unless match ≥ threshold AND quantized
   tokens/sec ≥ bf16 with zero mid-measure recompiles and closed
   program sets on BOTH engines.
+* **speculative compare** (``SERVE_SPEC_K > 0`` — docs/SERVING.md):
+  plain greedy engine vs the speculative engine (``SERVE_SPEC_DRAFT``
+  int8 self-draft or n-gram prompt lookup) on the same seeded greedy
+  load. Speculation in the greedy regime is **lossless by
+  construction**, so parity is gated bitwise; the script also gates
+  speculative tokens/sec ≥ ``SERVE_SPEC_MIN_SPEEDUP`` (1.4) × the
+  baseline, zero mid-measure recompiles, and both program sets closed
+  at their static counts (the speculative set is enlarged — verify +
+  draft programs — but still closed). Accept-rate p50/mean and
+  draft/verify time are reported.
 
 Env knobs (defaults in parentheses): ``SERVE_SLOTS`` (8),
 ``SERVE_BUCKETS`` ("8,16"; compare/longtail default covers the long
@@ -52,6 +62,9 @@ all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail),
 ``SERVE_POOL_SLOT_BUDGET`` (4 — the fixed byte budget, in dense slots),
 ``SERVE_KV_DTYPE`` / ``SERVE_WEIGHT_DTYPE`` (bf16 — int8 selects the
 quantization compare), ``SERVE_QUANT_MATCH_MIN`` (0.95),
+``SERVE_SPEC_K`` (0 — >0 selects the speculative compare),
+``SERVE_SPEC_DRAFT`` (int8 | ngram), ``SERVE_SPEC_NGRAM_N`` (3),
+``SERVE_SPEC_MIN_SPEEDUP`` (1.4),
 ``BENCH_MODEL`` (lm_tiny), ``BENCH_VOCAB`` (32000), plus the generic
 ``OBS_DIR``/``--events`` and ``COMPILATION_CACHE_DIR`` plumbing
 bench.py uses. With ``SLO_SPEC`` set (and ``OBS_DIR``) the bench runs
@@ -235,7 +248,7 @@ def serve_one_engine(model, params, reqs, seq_outs, *, engine_kwargs,
         "slot_occupancy_mean": round(server.occupancy_mean, 3),
         "decode_steps": server.stats["decode_steps"],
         "compile_count": engine.compile_count,
-        "programs_expected": len(engine.buckets) + 1,
+        "programs_expected": engine.programs_expected,
         "compiles_during_measure": engine.compile_count - compile_count_pre,
         "wall_s": round(wall_s, 2),
     }
@@ -474,6 +487,93 @@ def run_quant_compare(model, params, reqs, cfg, metric, *, budget_slots,
     return 0 if ok else 1
 
 
+def run_spec_compare(model, params, reqs, cfg, metric, *, max_len,
+                     profile, rate_rps, min_speedup):
+    """The speculative-decode certification (``SERVE_SPEC_K > 0``):
+    plain greedy engine vs the speculative engine (same slots, same
+    seeded load, same pool geometry). Gates: **bitwise greedy parity**
+    (every stream token-for-token equal — speculation must be lossless
+    in the greedy regime), speculative tokens/sec >= ``min_speedup`` x
+    the baseline, zero mid-measure recompiles and program sets closed
+    at their static counts on BOTH engines. Accept-rate p50/mean are
+    reported from the engine's per-tick tallies."""
+    import jax
+    import numpy as np
+
+    common = dict(
+        queue_depth=cfg.queue_depth,
+        prefills_per_step=cfg.prefills_per_step,
+        temperature=0.0, top_k=None,
+        admission_policy=cfg.build_admission_policy(),
+    )
+    base_kwargs = dict(
+        num_slots=cfg.num_slots, max_len=max_len, buckets=cfg.buckets,
+    )
+    ref_run, ref_streams, ref_engine = serve_one_engine(
+        model, params, reqs, None, engine_kwargs=base_kwargs, **common,
+    )
+    spec_kwargs = dict(
+        base_kwargs, spec_k=cfg.spec_k, spec_draft=cfg.spec_draft,
+        spec_ngram_n=cfg.spec_ngram_n,
+    )
+    spec_run, spec_streams, spec_engine = serve_one_engine(
+        model, params, reqs, None, engine_kwargs=spec_kwargs, **common,
+    )
+    parity = spec_streams == ref_streams  # bitwise, token for token
+    st = spec_engine.spec_stats
+    rates = st["accept_rates"]
+    speedup = (
+        spec_run["tokens_per_sec"] / ref_run["tokens_per_sec"]
+        if ref_run["tokens_per_sec"] else 0.0
+    )
+    detail = {
+        "profile": profile,
+        "requests": len(reqs),
+        "buckets": list(cfg.buckets),
+        "rate_rps": rate_rps,
+        "max_len": max_len,
+        "platform": jax.devices()[0].platform,
+        "spec_k": cfg.spec_k,
+        "spec_draft": cfg.spec_draft,
+        "greedy": ref_run,
+        "spec": spec_run,
+        "speedup": round(speedup, 2),
+        "min_speedup": min_speedup,
+        "parity": bool(parity),
+        "accept_rate_mean": round(float(np.mean(rates)), 4) if rates else None,
+        "accept_rate_p50": round(_percentile(sorted(rates), 0.5), 4)
+        if rates else None,
+        "tokens_per_verify": round(
+            st["tokens_committed"] / max(st["verify_ticks"], 1), 2
+        ),
+        "draft_ms_total": round(st["draft_s"] * 1e3, 1),
+        "verify_ms_total": round(st["verify_s"] * 1e3, 1),
+        "draft_bytes": {
+            k: v for k, v in spec_engine.byte_accounting().items()
+            if k.startswith("draft_")
+        } or None,
+    }
+    clean = (
+        ref_run["compiles_during_measure"] == 0
+        and spec_run["compiles_during_measure"] == 0
+    )
+    closed = all(
+        r["compile_count"] == r["programs_expected"]
+        for r in (ref_run, spec_run)
+    )
+    ok = clean and closed and parity and speedup >= min_speedup
+    record = {
+        "metric": metric,
+        # headline: speculative throughput on the same greedy load
+        "value": spec_run["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(speedup, 2),
+        "detail": detail,
+    }
+    _emit_record(record)
+    return 0 if ok else 1
+
+
 def start_live_plane(obs_dir):
     """Run the live telemetry plane (tail -> rollup -> SLO -> rollup.json)
     in a background thread for the duration of the bench — the thing an
@@ -567,14 +667,30 @@ def main() -> int:
             "the quantization compare runs on the dense layout — unset "
             "SERVE_KV_LAYOUT or the int8 dtypes"
         )
+    # Speculative compare (SERVE_SPEC_K > 0): greedy-vs-speculative,
+    # bitwise greedy parity gated (docs/SERVING.md).
+    spec = cfg.spec_k > 0
+    if spec and (quant or layout != "dense"):
+        raise SystemExit(
+            "the speculative compare runs on the dense native-dtype "
+            "engines — unset SERVE_KV_LAYOUT / the int8 dtypes or "
+            "SERVE_SPEC_K"
+        )
     match_min = float(env.get("SERVE_QUANT_MATCH_MIN", "0.95"))
+    min_speedup = float(env.get("SERVE_SPEC_MIN_SPEEDUP", "1.4"))
     temperature, top_k = (0.0, None) if quant else (0.8, 40)
     metric = (
-        "serve_int8_vs_bf16_tokens_per_sec" if quant
+        "serve_spec_vs_greedy_tokens_per_sec" if spec
+        else "serve_int8_vs_bf16_tokens_per_sec" if quant
         else "serve_paged_vs_dense_capacity" if layout == "compare"
         else "serve_continuous_tokens_per_sec"
     )
 
+    if spec:
+        # The verify window writes spec_k lookahead positions past a
+        # request's last token; both engines get the same headroom so
+        # the compare stays shape-for-shape fair.
+        max_len += cfg.spec_k
     try:
         model = get_model(
             model_name, num_classes=vocab, max_seq_len=max_len,
@@ -586,6 +702,13 @@ def main() -> int:
         )
         params = nn.unbox(variables["params"])
         reqs = build_requests(n_requests, rate_rps, seed, vocab, shapes)
+
+        if spec:
+            return run_spec_compare(
+                model, params, reqs, cfg, metric, max_len=max_len,
+                profile=profile, rate_rps=rate_rps,
+                min_speedup=min_speedup,
+            )
 
         if quant:
             return run_quant_compare(

@@ -685,6 +685,32 @@ def test_bench_trend_skips_outage_tiers_and_flags_real_drops(tmp_path):
     assert result["rows"][-1]["skip"] == "error"
 
 
+def test_bench_trend_spec_k_change_is_skip_not_regression(tmp_path):
+    """A spec_k protocol change (speculative tier on/off or re-tuned)
+    is a new baseline — same treatment as a dtype change; absent spec_k
+    (pre-speculation records) normalizes to 0 and stays comparable."""
+    from scripts.bench_trend import analyze
+
+    _trend_file(tmp_path, 1, 100.0)          # pre-spec record: spec_k=0
+    _trend_file(tmp_path, 2, 98.0)           # still comparable
+    with open(tmp_path / "BENCH_r03.json", "w") as fh:
+        json.dump({"n": 3, "rc": 0, "parsed": {
+            "metric": "m", "value": 60.0, "unit": "u",
+            "detail": {"platform": "tpu", "spec_k": 4},
+        }}, fh)
+    result = analyze(sorted(map(str, tmp_path.glob("BENCH_r*.json"))))
+    assert result["ok"]  # the -39% "drop" is a protocol change
+    assert result["rows"][2]["skip"] == "spec_change:k=0->k=4"
+    # and the new spec protocol becomes its own comparable baseline
+    with open(tmp_path / "BENCH_r04.json", "w") as fh:
+        json.dump({"n": 4, "rc": 0, "parsed": {
+            "metric": "m", "value": 30.0, "unit": "u",
+            "detail": {"platform": "tpu", "spec_k": 4},
+        }}, fh)
+    result = analyze(sorted(map(str, tmp_path.glob("BENCH_r*.json"))))
+    assert not result["ok"]  # -50% like-for-like at spec_k=4 IS real
+
+
 def test_bench_trend_real_trajectory_is_clean():
     """The repo's own BENCH_r*.json history must parse and pass — rounds
     4-5 (relay outage) read as skips, not 100% regressions."""

@@ -159,6 +159,12 @@ def test_param_tree_roundtrip_and_eval_shape(lm_and_params):
     assert quantlib.tree_byte_split(shapes) == quantlib.tree_byte_split(
         quantlib.quantize_params(params)
     )
+    # one-shot invariant: re-quantizing an already-quantized tree would
+    # re-scale the int8 payload into garbage — rejected loudly (the
+    # speculative tier's int8-draft-of-int8-target conflict rule guards
+    # the serving-side path; this pins the pass itself)
+    with pytest.raises(ValueError, match="already quantized"):
+        quantlib.quantize_params(quantlib.quantize_params(params))
 
 
 def test_full_forward_logit_error_bound(lm_and_params):

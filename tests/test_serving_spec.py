@@ -1,0 +1,507 @@
+"""Speculative decode tier oracles (``SlotEngine(spec_k > 0)``).
+
+The speculative tier's contract, pinned here (CPU tier):
+
+* **Greedy losslessness** — a speculative greedy stream is bitwise the
+  sequential ``inference.generate`` stream (and therefore the non-spec
+  engine's stream) whatever the co-scheduling: staggered joins, mixed
+  buckets, mid-stream cancels with immediate slot reuse. Dense AND
+  paged twins, int8 self-draft AND n-gram prompt-lookup sources —
+  correctness never depends on draft quality.
+* **Distribution preservation** — the rejection-sampling acceptance
+  (``sampling.spec_verify_slots``) leaves sampled output distributed
+  EXACTLY as ``inference._sample`` (point-mass proposals: accept with
+  the target's own probability, resample from the draft-masked
+  residual). Chi-squared-bounded against ``_sample`` at fixed seeds.
+* **Closed program set, enlarged** — verify (+ draft programs for the
+  int8 source) join the set at warmup; ``compile_count ==
+  programs_expected`` and an admission/eviction churn compiles nothing.
+* **Lookahead reservation** — the verify writes ``spec_k`` candidate
+  positions past the committed cursor; paged admission reserves the
+  blocks, dense admission reserves ``max_len`` headroom.
+* **SERVE_SPEC_* config contract** — env parsing, engine kwargs, the
+  rejection rules (``spec_k < 0``, int8 draft on an int8-weight
+  target, unknown sources).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.inference import _sample, generate
+from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+from distributeddeeplearning_tpu.serving import (
+    NgramDrafter,
+    ReqSpec,
+    Request,
+    ServeConfig,
+    Server,
+    SlotEngine,
+)
+from distributeddeeplearning_tpu.serving.sampling import spec_verify_slots
+
+VOCAB, MAX_LEN = 64, 48
+BUCKETS = (4, 8, 16)
+K = 3
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=MAX_LEN,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import flax.linen as nn
+
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, MAX_LEN), jnp.int32),
+        train=False,
+    )
+    return nn.unbox(variables["params"])
+
+
+@pytest.fixture(scope="module")
+def _spec_engine(model, params):
+    """Warmed int8-self-draft engine, shared module-wide."""
+    eng = SlotEngine(
+        model, params, num_slots=4, max_len=MAX_LEN, buckets=BUCKETS,
+        spec_k=K, spec_draft="int8",
+    )
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def spec_engine(_spec_engine):
+    for s in _spec_engine.active_slots:
+        _spec_engine.release(s)
+    yield _spec_engine
+    for s in _spec_engine.active_slots:
+        _spec_engine.release(s)
+
+
+@pytest.fixture(scope="module")
+def _paged_spec_engine(model, params):
+    """Warmed paged twin on the zero-device-cost n-gram source."""
+    eng = SlotEngine(
+        model, params, num_slots=4, max_len=MAX_LEN, buckets=BUCKETS,
+        kv_layout="paged", block_size=4,
+        spec_k=K, spec_draft="ngram",
+    )
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def paged_spec_engine(_paged_spec_engine):
+    for s in _paged_spec_engine.active_slots:
+        _paged_spec_engine.release(s)
+    yield _paged_spec_engine
+    for s in _paged_spec_engine.active_slots:
+        _paged_spec_engine.release(s)
+
+
+def _prompt(rng, n):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _assert_greedy_parity(h, model, params):
+    ref = np.asarray(generate(
+        model, params, np.asarray(h.request.prompt, np.int32)[None],
+        max_new_tokens=h.request.max_new_tokens,
+        eos_token=h.request.eos_token,
+    ))[0]
+    got = h.tokens
+    assert got.shape[0] <= ref.shape[0]
+    np.testing.assert_array_equal(got, ref[: got.shape[0]])
+
+
+# -- host-side drafter (serving/spec.py) ---------------------------------
+
+
+def test_ngram_drafter_lookup_and_fallback():
+    d = NgramDrafter(3)
+    # suffix [2, 3] recurs at index 1 — continuation is [4, 1, 2]
+    np.testing.assert_array_equal(
+        d.propose([1, 2, 3, 4, 1, 2, 3], 3), [4, 1, 2]
+    )
+    # no 2-gram match, but the 1-gram suffix [9] recurs -> continues it
+    np.testing.assert_array_equal(d.propose([9, 5, 9, 7, 9], 2), [7, 9])
+    # nothing recurs: the deliberately-rejectable zero proposal
+    np.testing.assert_array_equal(d.propose([1, 2, 3, 4], 2), [0, 0])
+    # match near the end: short continuation cycles, never zero-pads
+    np.testing.assert_array_equal(d.propose([7, 8, 7, 8], 4)[:2], [7, 8])
+    assert d.stats["proposals"] == 4
+    assert d.stats["lookups_hit"] == 3
+    with pytest.raises(ValueError, match="ngram n"):
+        NgramDrafter(1)
+
+
+# -- config contract ------------------------------------------------------
+
+
+def test_spec_config_env_kwargs_and_validation(model):
+    cfg = ServeConfig.from_env({
+        "SERVE_SPEC_K": "4", "SERVE_SPEC_DRAFT": "ngram",
+        "SERVE_SPEC_NGRAM_N": "5",
+    })
+    assert cfg.spec_k == 4 and cfg.spec_draft == "ngram"
+    assert cfg.spec_ngram_n == 5
+    kw = cfg.engine_kwargs()
+    assert kw["spec_k"] == 4 and kw["spec_draft"] == "ngram"
+    assert kw["spec_ngram_n"] == 5
+    dflt = ServeConfig.from_env({})
+    assert dflt.spec_k == 0
+    assert "spec_k" not in dflt.engine_kwargs()  # off = old kwargs shape
+    tiny = TransformerLM(variant="tiny", vocab_size=8, max_seq_len=8)
+    with pytest.raises(ValueError, match="spec_k"):
+        SlotEngine(tiny, {}, spec_k=-1)
+    with pytest.raises(ValueError, match="spec_draft"):
+        SlotEngine(tiny, {}, spec_k=2, spec_draft="off")
+    with pytest.raises(ValueError, match="spec_draft"):
+        SlotEngine(tiny, {}, spec_k=2, spec_draft="medium")
+    # int8 draft on an int8-weight target: no cheaper tier to draft from
+    with pytest.raises(ValueError, match="weight tier"):
+        SlotEngine(tiny, {}, spec_k=2, spec_draft="int8",
+                   weight_dtype="int8")
+    with pytest.raises(ValueError, match="spec_ngram_n"):
+        SlotEngine(tiny, {}, spec_k=2, spec_draft="ngram", spec_ngram_n=1)
+    # spec_k=0 leaves the other knobs inert (no validation tripwires)
+    SlotEngine(tiny, {}, spec_k=0, spec_draft="off")
+
+
+def test_spec_headroom_reserved_at_admission(spec_engine):
+    """Dense lookahead reservation: prompt + max_new + spec_k must fit
+    max_len — dynamic_update_slice clamps out-of-range verify writes
+    backwards, which would corrupt committed rows."""
+    ok = ReqSpec(np.zeros(8, np.int32), MAX_LEN - 8 - K)
+    spec_engine.validate_spec(ok)
+    too_long = ReqSpec(np.zeros(8, np.int32), MAX_LEN - 8 - K + 1)
+    with pytest.raises(ValueError, match="lookahead"):
+        spec_engine.validate_spec(too_long)
+
+
+# -- greedy losslessness (the flagship oracle) ---------------------------
+
+
+def test_spec_greedy_bitwise_staggered_mixed_lengths(
+    spec_engine, model, params
+):
+    """8 greedy requests over 4 slots, mixed buckets, staggered joins,
+    different max_new — every speculative stream bitwise-equal to
+    sequential generate, and speculation actually engaged (accepted
+    drafts > 0, multi-token commits happened)."""
+    rng = np.random.RandomState(0)
+    acc0 = spec_engine.spec_stats["tokens_accepted"]
+    server = Server(spec_engine, prefills_per_step=1)
+    handles = [
+        server.submit(Request(prompt=_prompt(rng, n), max_new_tokens=m))
+        for n, m in [(3, 6), (7, 9), (12, 4), (16, 10),
+                     (4, 12), (9, 3), (14, 7), (5, 5)]
+    ]
+    server.drain()
+    assert all(h.status == "done" for h in handles)
+    assert all(
+        len(h.new_tokens) == h.request.max_new_tokens for h in handles
+    )
+    for h in handles:
+        _assert_greedy_parity(h, model, params)
+    assert spec_engine.spec_stats["tokens_accepted"] > acc0
+
+
+def test_spec_greedy_paged_twin_bitwise(paged_spec_engine, model, params):
+    """The paged + n-gram twin of the flagship: parity holds through
+    block-table routing and whatever the (model-free) drafter proposes."""
+    rng = np.random.RandomState(1)
+    server = Server(paged_spec_engine, prefills_per_step=2)
+    handles = [
+        server.submit(Request(prompt=_prompt(rng, n), max_new_tokens=m))
+        for n, m in [(3, 8), (8, 10), (13, 6), (16, 9), (5, 12)]
+    ]
+    server.drain()
+    assert all(h.status == "done" for h in handles)
+    for h in handles:
+        _assert_greedy_parity(h, model, params)
+
+
+def test_spec_sampled_churn_cancel_zero_compiles(spec_engine):
+    """Sampled + greedy mix under churn (staggered joins, a mid-stream
+    cancel freeing a slot that is immediately re-admitted into): the
+    whole run triggers ZERO backend compiles, and the same seeded load
+    replayed is bitwise-deterministic (speculative sampled streams are
+    deterministic given the request rng, tick for tick)."""
+    from jax._src import monitoring
+
+    compiles = []
+    monitoring.register_event_duration_secs_listener(
+        lambda event, duration, **kw: compiles.append(event)
+        if "backend_compile" in event else None
+    )
+    baseline = len(compiles)
+
+    def run_load():
+        rng = np.random.RandomState(2)
+        server = Server(spec_engine, prefills_per_step=2)
+        mk = lambda n, m, seed, **kw: server.submit(Request(  # noqa: E731
+            prompt=_prompt(rng, n), max_new_tokens=m, rng=seed, **kw
+        ))
+        wave1 = [
+            mk(3, 10, 11, temperature=0.9, top_k=8),
+            mk(8, 12, 12, temperature=0.7, top_k=5),
+            mk(13, 12, 13),  # greedy neighbour in the same pool
+            mk(16, 8, 14, temperature=1.1, top_k=40, top_p=0.9),
+        ]
+        for _ in range(2):
+            server.step()
+        victim = wave1[1]
+        victim.cancel()
+        wave2 = [mk(5, 9, 21, temperature=0.8, top_k=6)]
+        server.drain()
+        assert victim.status == "cancelled"
+        return [list(h.new_tokens) for h in wave1 + wave2]
+
+    first = run_load()
+    second = run_load()
+    assert len(compiles) == baseline, compiles[baseline:]
+    assert first == second
+
+
+def test_spec_eos_truncates_mid_commit(spec_engine, model, params):
+    """An eos landing inside a multi-token commit cuts the stream at
+    the eos token — same semantics as the non-spec engine and
+    generate's pad-after-eos."""
+    rng = np.random.RandomState(3)
+    prompt = _prompt(rng, 5)
+    ref = np.asarray(generate(model, params, prompt[None],
+                              max_new_tokens=12))[0]
+    eos = int(ref[5 + 2])  # third greedy token becomes the eos
+    server = Server(spec_engine)
+    h = server.submit(Request(
+        prompt=prompt, max_new_tokens=12, eos_token=eos,
+    ))
+    server.drain()
+    assert h.finish_reason == "eos"
+    gen = ref[5:]
+    first = int(np.argmax(gen == eos))
+    assert len(h.new_tokens) == first + 1
+    assert h.new_tokens[-1] == eos
+    _assert_greedy_parity(h, model, params)
+    assert spec_engine.occupancy == 0.0
+
+
+def test_generate_engine_route_spec_greedy_bitwise(
+    spec_engine, model, params
+):
+    """inference.generate(engine=spec server): greedy B=1 and B>1
+    bitwise through the speculative pool."""
+    rng = np.random.RandomState(4)
+    server = Server(spec_engine)
+    p1 = rng.randint(0, VOCAB, size=(1, 6)).astype(np.int32)
+    ref = np.asarray(generate(model, params, p1, max_new_tokens=8))
+    got = np.asarray(generate(model, params, p1, max_new_tokens=8,
+                              engine=server))
+    np.testing.assert_array_equal(got, ref)
+    pb = rng.randint(0, VOCAB, size=(3, 5)).astype(np.int32)
+    ref = np.asarray(generate(model, params, pb, max_new_tokens=6))
+    got = np.asarray(generate(model, params, pb, max_new_tokens=6,
+                              engine=server))
+    np.testing.assert_array_equal(got, ref)
+
+
+# -- distribution preservation (rejection sampler vs _sample) ------------
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k,top_p,draft_tok",
+    [
+        (1.0, None, None, 3),   # plain temperature
+        (0.8, 4, None, 2),      # top-k filter (draft outside the kept set
+                                # on these logits: pure residual path)
+        (1.0, None, 0.9, 5),    # nucleus filter
+    ],
+)
+def test_spec_rejection_sampler_matches_sample_distribution(
+    temperature, top_k, top_p, draft_tok
+):
+    """Two-sample chi-squared: N committed first tokens from the
+    speculative acceptance vs N draws from inference._sample on the
+    same logits/config. Fixed seeds — deterministic, not flaky. Bound:
+    the 0.999 quantile of chi2(dof) is ~'dof + 4*sqrt(dof) + 10'; we
+    use a slightly looser static bound per config."""
+    v, n = 16, 3000
+    rng = np.random.RandomState(0)
+    logits0 = (rng.randn(v)).astype(np.float32)
+    logits1 = (rng.randn(v)).astype(np.float32)
+    keys = np.asarray(
+        jax.random.split(jax.random.PRNGKey(7), n * 2), np.uint32
+    ).reshape(n, 2, 2)
+    logits = np.broadcast_to(
+        np.stack([logits0, logits1])[None], (n, 2, v)
+    ).astype(np.float32)
+    drafts = np.full((n, 1), draft_tok, np.int32)
+    committed, _ = jax.jit(spec_verify_slots)(
+        jnp.asarray(logits), jnp.asarray(drafts), jnp.asarray(keys),
+        jnp.full((n,), temperature, jnp.float32),
+        jnp.full((n,), top_k or 0, jnp.int32),
+        jnp.full((n,), top_p or 0.0, jnp.float32),
+    )
+    first = np.asarray(committed)[:, 0]
+    ref_keys = jax.random.split(jax.random.PRNGKey(99), n)
+    ref = np.asarray(jax.jit(jax.vmap(
+        lambda kk: _sample(
+            jnp.asarray(logits0)[None], kk, temperature, top_k, top_p
+        )[0]
+    ))(ref_keys))
+    o1 = np.bincount(first, minlength=v).astype(np.float64)
+    o2 = np.bincount(ref, minlength=v).astype(np.float64)
+    tot = o1 + o2
+    chi2 = float(np.sum(np.where(
+        tot > 0, (o1 - o2) ** 2 / np.maximum(tot, 1), 0.0
+    )))
+    dof = int((tot > 0).sum()) - 1
+    bound = dof + 4 * np.sqrt(dof) + 10
+    assert chi2 < bound, (chi2, dof, bound)
+
+
+# -- program budget -------------------------------------------------------
+
+
+def test_spec_program_count_enlarged_but_closed(
+    spec_engine, paged_spec_engine
+):
+    """int8 source: decode + buckets prefills + verify + draft phase +
+    buckets draft prefills. ngram source: decode + buckets + verify.
+    Warmup stays idempotent at the new counts."""
+    want_int8 = 2 * len(BUCKETS) + 3
+    assert spec_engine.programs_expected == want_int8
+    assert spec_engine.compile_count == want_int8
+    spec_engine.warmup()
+    assert spec_engine.compile_count == want_int8
+    want_ngram = len(BUCKETS) + 2
+    assert paged_spec_engine.programs_expected == want_ngram
+    assert paged_spec_engine.compile_count == want_ngram
+    paged_spec_engine.warmup()
+    assert paged_spec_engine.compile_count == want_ngram
+
+
+# -- paged lookahead reservation -----------------------------------------
+
+
+def test_spec_paged_block_reservation_lookahead(model, params):
+    """Paged admission reserves spec_k positions ahead: blocks_needed
+    grows vs the non-spec engine, a request that would exactly fill the
+    pool without lookahead no longer fits, and worst-case validation
+    names the pool."""
+    bs = 4
+    base = SlotEngine(
+        model, params, num_slots=2, max_len=MAX_LEN, buckets=BUCKETS,
+        kv_layout="paged", block_size=bs, num_blocks=9,
+        prefix_cache=False,
+    )
+    spec = SlotEngine(
+        model, params, num_slots=2, max_len=MAX_LEN, buckets=BUCKETS,
+        kv_layout="paged", block_size=bs, num_blocks=9,
+        prefix_cache=False, spec_k=K, spec_draft="ngram",
+    )
+    # 8 prompt + 9 new -> 16 written positions = 4 blocks without
+    # lookahead; +3 lookahead crosses into a 5th block.
+    assert base.blocks_needed(8, 9) == 4
+    assert spec.blocks_needed(8, 9) == 5
+    req = ReqSpec(np.zeros(8, np.int32), 9)
+    # The 8-block free pool (9 minus the trash block) fits two plain
+    # requests but NOT two speculative ones.
+    assert base.can_admit(req) and spec.can_admit(req)
+    base.allocator.alloc(4)
+    spec.allocator.alloc(4)
+    assert base.can_admit(req)
+    assert not spec.can_admit(req)
+    with pytest.raises(ValueError, match="KV blocks"):
+        spec.validate_spec(ReqSpec(np.zeros(16, np.int32), 22))
+
+
+# -- teacher forcing (the PR-8 hook, speculative edition) ----------------
+
+
+def test_spec_force_token_teacher_forcing(spec_engine, model, params):
+    """force_token drives the verify's NEXT window: given the same
+    forced context, the spec tick's first committed token equals the
+    non-spec greedy token at that context (generate reference)."""
+    rng = np.random.RandomState(5)
+    prompt = _prompt(rng, 6)
+    spec_engine.prefill(0, ReqSpec(prompt=prompt, max_new_tokens=10))
+    forced = int(prompt[0])  # an off-policy context token
+    spec_engine.force_token(0, forced)
+    [(slot, toks, _eos)] = spec_engine.spec_step()
+    assert slot == 0
+    ctx = np.concatenate([prompt, [forced]]).astype(np.int32)
+    ref = np.asarray(generate(
+        model, params, ctx[None], max_new_tokens=1,
+    ))[0]
+    assert toks[0] == int(ref[-1])
+    spec_engine.release(0)
+
+
+# -- observability --------------------------------------------------------
+
+
+def test_spec_obs_gauges_counters_and_report(spec_engine, tmp_path):
+    """serve.spec_* gauges/counters land on the bus; the obs_report
+    serving view carries them and renders the acceptance line."""
+    from distributeddeeplearning_tpu import obs
+    from distributeddeeplearning_tpu.obs.report import (
+        load, render, summarize,
+    )
+
+    bus = obs.configure(str(tmp_path), run_id="spec-test", proc=0,
+                        install_handlers=False)
+    try:
+        server = Server(spec_engine)
+        rng = np.random.RandomState(6)
+        hs = [server.submit(Request(prompt=_prompt(rng, n),
+                                    max_new_tokens=8))
+              for n in (4, 9)]
+        server.drain()
+        assert all(h.status == "done" for h in hs)
+        bus.flush()
+    finally:
+        obs.reset()
+    summary = summarize(load([str(tmp_path)]))
+    srv = summary["serving"]
+    assert srv is not None
+    acc, rej = srv["spec_tokens_accepted"], srv["spec_tokens_rejected"]
+    assert acc + rej > 0
+    assert srv["spec_accept_rate"] is not None
+    assert srv["spec_draft_ms"] is not None
+    assert srv["spec_verify_ms"] is not None
+    text = render(summary)
+    assert "speculative:" in text
+    assert "draft tokens" in text
+
+
+def test_spec_accept_rate_slo_watchable():
+    """The accept-rate gauge feeds the live plane like any other metric:
+    an SLO_SPEC objective on serve.spec_accept_rate:last evaluates from
+    the rollup aggregator and burns when acceptance collapses."""
+    from distributeddeeplearning_tpu.obs.rollup import WindowedAggregator
+    from distributeddeeplearning_tpu.obs.slo import (
+        SloEngine, parse_slo_spec,
+    )
+
+    eng = SloEngine(
+        parse_slo_spec("serve.spec_accept_rate:last >= 0.5"),
+        emit=lambda name, **kw: None,
+    )
+    agg = WindowedAggregator(10.0, slice_s=1.0, retain_s=eng.retain_s())
+    agg.add({"kind": "gauge", "name": "serve.spec_accept_rate",
+             "value": 0.9, "wall": 1000.0})
+    st = eng.evaluate(agg, now=1000.0)[0]
+    assert not st["burning"]
+    agg.add({"kind": "gauge", "name": "serve.spec_accept_rate",
+             "value": 0.1, "wall": 1001.0})
+    st = eng.evaluate(agg, now=1001.0)[0]
+    assert st["burn"] > 1.0
