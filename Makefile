@@ -24,7 +24,7 @@ PY                ?= python
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
         obs-watch bench-trend accum-memory fault-suite serve-bench \
-        serve-bench-spec native \
+        serve-bench-spec fleet-bench native \
         provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -100,6 +100,13 @@ serve-bench-spec:	## speculative-decode compare: greedy vs int8 self-draft
 	SERVE_SPEC_K=$(or $(SPEC_K),4) SERVE_SPEC_DRAFT=$(or $(SPEC_DRAFT),int8) \
 	    SERVE_MAX_NEW=64 SERVE_REQUESTS=24 SERVE_RATE_RPS=0 \
 	    SERVE_PREFILLS_PER_STEP=8 $(PY) scripts/serve_bench.py
+
+fleet-bench:	## multi-replica fleet: 1 vs SERVE_REPLICAS(=2) replicas on a
+	## seeded multi-tenant load — gates scaling (CPU-honest basis), flat
+	## p99 TTFT, weighted fairness, bitwise per-request parity, closed
+	## program sets per replica (docs/SERVING.md fleet tier;
+	## serve_lm_fleet recertify row)
+	$(PY) scripts/fleet_bench.py
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
 	$(PY) scripts/accum_memory.py

@@ -160,15 +160,22 @@ def generate(
     pad_token: Optional[int] = None,
     rng: Optional[jax.Array] = None,
     engine=None,
+    on_token=None,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, Tp]
     int32). Returns ``[B, Tp + max_new_tokens]`` (prompt included).
 
-    ``engine``: a ``serving.SlotEngine`` or ``serving.Server`` — rows
-    are then served as continuous-batching requests on its slot pool
-    (one program regardless of shape/config) instead of compiling this
-    request-shaped scan; bitwise-equal at B=1, per-row keys at B>1
+    ``engine``: a ``serving.SlotEngine``, ``serving.Server`` or fleet
+    ``serving.Router`` — rows are then served as continuous-batching
+    requests on its slot pool(s) (one program regardless of
+    shape/config) instead of compiling this request-shaped scan;
+    bitwise-equal at B=1, per-row keys at B>1
     (``serving.generate_with_engine``).
+
+    ``on_token``: incremental streaming callback ``(row, token)``,
+    engine route only — the serving loop invokes it the moment each
+    token is committed, and the returned array contains exactly the
+    streamed tokens.
 
     ``model`` is a trained ``TransformerLM`` (its ``decode`` field is
     overridden here); ``params`` the trained parameters (e.g.
@@ -190,6 +197,11 @@ def generate(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if on_token is not None and engine is None:
+        raise ValueError(
+            "on_token streaming requires the engine route "
+            "(generate(engine=server_or_router))"
+        )
     if engine is not None:
         from distributeddeeplearning_tpu.serving import generate_with_engine
 
@@ -201,6 +213,7 @@ def generate(
             top_k=top_k, top_p=top_p, eos_token=eos_token,
             pad_token=pad_token,
             rng=None if rng is None else np.asarray(rng, np.uint32),
+            on_token=on_token,
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -17,9 +17,12 @@ The **live plane** reads the same files while the run is alive:
 from distributeddeeplearning_tpu.obs.bus import (
     DEFAULT_RING_SIZE,
     EventBus,
+    bind_bus,
+    bound_bus,
     configure,
     configure_from_env,
     counter,
+    current_bus,
     flush,
     gauge,
     get_bus,
@@ -48,6 +51,9 @@ __all__ = [
     "SloEngine",
     "Tailer",
     "WindowedAggregator",
+    "bind_bus",
+    "bound_bus",
+    "current_bus",
     "configure",
     "configure_from_env",
     "counter",

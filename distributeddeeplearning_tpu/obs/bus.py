@@ -277,11 +277,17 @@ class EventBus:
 
 
 # ---------------------------------------------------------------------------
-# Process-global bus + crash handlers
+# Process-global bus + crash handlers + per-thread binding
 # ---------------------------------------------------------------------------
 
 _GLOBAL_LOCK = threading.Lock()
 _GLOBAL: Optional[EventBus] = None
+# Thread-local bus override (serving fleet, docs/SERVING.md): a replica
+# worker thread binds its OWN EventBus (proc "p0-s<k>") so every
+# instrumentation site it runs — scheduler ticks, engine warmup spans,
+# pool gauges — lands in that replica's event stream without any call
+# site holding a bus reference. Unbound threads keep the global bus.
+_TLS = threading.local()
 _handlers_installed = False
 _prev_excepthook = None
 _prev_sigterm = None
@@ -296,6 +302,41 @@ def get_bus() -> EventBus:
         if _GLOBAL is None:
             _GLOBAL = EventBus()
         return _GLOBAL
+
+
+def current_bus() -> EventBus:
+    """The bus the *calling thread* emits to: its bound bus when one is
+    installed (:func:`bind_bus` / :func:`bound_bus`), the global bus
+    otherwise. Every module-level convenience routes through this, so
+    code instrumented with ``obs.counter(...)`` transparently writes to
+    a replica's private stream inside that replica's thread."""
+    bus = getattr(_TLS, "bus", None)
+    return bus if bus is not None else get_bus()
+
+
+def bind_bus(bus: Optional[EventBus]) -> Optional[EventBus]:
+    """Bind ``bus`` as this thread's emission target (None unbinds).
+    Returns the previously bound bus (None when the thread was on the
+    global bus) so callers can restore it."""
+    prev = getattr(_TLS, "bus", None)
+    _TLS.bus = bus
+    return prev
+
+
+@contextlib.contextmanager
+def bound_bus(bus: Optional[EventBus]) -> Iterator[Optional[EventBus]]:
+    """Scope a thread-local bus binding: emissions inside the block go
+    to ``bus``; the previous binding is restored on exit. ``None`` is a
+    no-op passthrough (keeps call sites branch-free when a component
+    may or may not own a private stream)."""
+    if bus is None:
+        yield None
+        return
+    prev = bind_bus(bus)
+    try:
+        yield bus
+    finally:
+        bind_bus(prev)
 
 
 def configure(
@@ -390,6 +431,7 @@ def reset() -> None:
     """Tests only: restore handlers and drop back to a fresh ring-only
     bus."""
     global _GLOBAL, _handlers_installed, _prev_excepthook, _prev_sigterm
+    _TLS.bus = None  # unbind the calling thread (other threads own theirs)
     with _GLOBAL_LOCK:
         if _GLOBAL is not None:
             _GLOBAL.close()
@@ -414,30 +456,31 @@ def _close_at_exit() -> None:  # pragma: no cover - interpreter teardown
             _GLOBAL.close()
 
 
-# Module-level conveniences: route to the global bus so call sites read
-# `obs.counter(...)` without holding a bus reference.
+# Module-level conveniences: route to the calling thread's bus (bound
+# replica stream or the global bus) so call sites read `obs.counter(...)`
+# without holding a bus reference.
 
 def counter(name: str, n: int = 1, **labels: Any) -> None:
-    get_bus().counter(name, n, **labels)
+    current_bus().counter(name, n, **labels)
 
 
 def gauge(name: str, value: float, **labels: Any) -> None:
-    get_bus().gauge(name, value, **labels)
+    current_bus().gauge(name, value, **labels)
 
 
 def point(name: str, **labels: Any) -> None:
-    get_bus().point(name, **labels)
+    current_bus().point(name, **labels)
 
 
 def span(name: str, **labels: Any):
-    return get_bus().span(name, **labels)
+    return current_bus().span(name, **labels)
 
 
 def span_event(
     name: str, dur: float, t: Optional[float] = None, **labels: Any
 ) -> None:
-    get_bus().span_event(name, dur, t=t, **labels)
+    current_bus().span_event(name, dur, t=t, **labels)
 
 
 def flush() -> None:
-    get_bus().flush()
+    current_bus().flush()

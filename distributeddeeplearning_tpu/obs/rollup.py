@@ -118,6 +118,14 @@ class WindowedAggregator:
         # name -> (wall, value): last value wins, whole-stream (a gauge
         # that stopped updating is still the current state, just old).
         self.gauges: Dict[str, tuple] = {}
+        # proc -> name -> (wall, value): the same last-value-wins gauges
+        # keyed by emitting stream. One process's serving fleet runs N
+        # replicas, each on its own event stream (proc "p0-s<k>" —
+        # obs/bus.py bound_bus); collapsing their occupancy/queue gauges
+        # into one last-writer-wins cell would hide N-1 replicas, so the
+        # per-proc view keeps each stream's own state. Bounded by
+        # (#procs × #gauge names), not by event count.
+        self.gauges_by_proc: Dict[str, Dict[str, tuple]] = {}
         self.events_total = 0
         #: event-time clock: the max wall timestamp ever ingested
         self.now: Optional[float] = None
@@ -152,6 +160,11 @@ class WindowedAggregator:
             prev = self.gauges.get(name)
             if prev is None or wall >= prev[0]:
                 self.gauges[name] = (wall, event.get("value"))
+            proc = str(event.get("p", "?"))
+            per = self.gauges_by_proc.setdefault(proc, {})
+            pprev = per.get(name)
+            if pprev is None or wall >= pprev[0]:
+                per[name] = (wall, event.get("value"))
         elif kind == "span":
             try:
                 dur = float(event.get("dur", 0.0))
@@ -293,6 +306,23 @@ class WindowedAggregator:
             "spans": spans,
             "points": points,
         }
+        # Per-stream gauge view (serving fleet): published only when more
+        # than one stream emitted gauges — the single-stream case is
+        # exactly the flat `gauges` section already.
+        if len(self.gauges_by_proc) > 1:
+            snap["procs"] = {
+                proc: {
+                    name: {
+                        "value": value,
+                        "age_s": (
+                            round(max(now - wall, 0.0), 3)
+                            if now is not None else None
+                        ),
+                    }
+                    for name, (wall, value) in sorted(per.items())
+                }
+                for proc, per in sorted(self.gauges_by_proc.items())
+            }
         if slo is not None:
             snap["slo"] = slo
         return snap

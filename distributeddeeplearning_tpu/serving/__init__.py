@@ -67,3 +67,12 @@ from distributeddeeplearning_tpu.serving.scheduler import (  # noqa: F401
     ServeConfig,
     generate_with_engine,
 )
+from distributeddeeplearning_tpu.serving.fleet import (  # noqa: F401
+    ControllerConfig,
+    FleetConfig,
+    FleetController,
+    FleetHandle,
+    Replica,
+    Router,
+    build_fleet,
+)

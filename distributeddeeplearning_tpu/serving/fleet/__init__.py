@@ -1,0 +1,38 @@
+"""Multi-replica serving fleet: router, fairness, streaming, autoscale.
+
+PRs 5–9 built a complete single-engine serving tier (SlotEngine
+continuous batching, paged KV + prefix cache, int8 quantization,
+speculative decode, adaptive admission) — one engine, one device. This
+package is the next layer up (ROADMAP item 1): N warmed engines behind
+one front door.
+
+* :class:`~.replica.Replica` — one SlotEngine + Server on its own pump
+  thread and its own event stream (``events-p0-s<k>.jsonl``), with a
+  drain/fault lifecycle classified by the faults exit taxonomy.
+* :class:`~.router.Router` — per-tenant deficit-weighted fair queueing,
+  prefix-affinity/least-loaded placement, zero-drop drain and fault
+  re-routing (splicing restarts on the per-request determinism
+  contract), incremental token streaming, and the
+  ``serve.fleet_pressure`` autoscale gauge.
+* :class:`~.controller.FleetController` — consumes the pressure signal
+  between ticks to add or drain replicas.
+
+Certified by ``scripts/fleet_bench.py`` (``make fleet-bench``) and
+``tests/test_serving_fleet.py``; architecture in docs/SERVING.md.
+"""
+
+from distributeddeeplearning_tpu.serving.fleet.controller import (  # noqa: F401
+    ControllerConfig,
+    FleetController,
+)
+from distributeddeeplearning_tpu.serving.fleet.replica import (  # noqa: F401
+    Replica,
+)
+from distributeddeeplearning_tpu.serving.fleet.router import (  # noqa: F401
+    DEFAULT_TENANT,
+    FleetConfig,
+    FleetHandle,
+    Router,
+    build_fleet,
+    parse_tenant_weights,
+)

@@ -1,0 +1,176 @@
+"""Autoscaler: turn the router's pressure signal into replica count.
+
+The :class:`~distributeddeeplearning_tpu.serving.fleet.router.Router`
+publishes ``serve.fleet_pressure`` every tick (demanded capacity /
+ready capacity, block saturation on paged fleets). This controller
+consumes that signal *between* ticks and moves the fleet toward the
+demand: sustained pressure above the high watermark adds a replica
+(factory-built, warmed in its own thread — serving never pauses);
+sustained pressure below the low watermark drains the least-loaded
+replica (its queued requests re-route immediately, running streams
+finish) and removes it once drained.
+
+Signal sources, in priority order:
+
+* an injected ``reader`` callable (tests);
+* the live plane's ``rollup.json`` (``snapshot_path`` — the gauge as
+  every other consumer sees it, dashboard included);
+* the router's own ``last_pressure`` (in-process default).
+
+Hysteresis is tick-counted, not wall-timed, so the controller is
+deterministic under synthetic pressure traces (oracle-tested) and the
+caller owns the cadence (``FleetController.tick`` from the serving
+loop, a supervisor thread, or a cron).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.serving.fleet.replica import Replica
+from distributeddeeplearning_tpu.serving.fleet.router import Router
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    """Watermarks + hysteresis for the autoscaler."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    high_pressure: float = 1.0   # demand >= ready capacity
+    low_pressure: float = 0.35
+    up_ticks: int = 3            # consecutive hot ticks before scale-up
+    down_ticks: int = 8          # consecutive cold ticks before drain
+
+    def validate(self) -> None:
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min {self.min_replicas} <= max "
+                f"{self.max_replicas}"
+            )
+        if self.low_pressure >= self.high_pressure:
+            raise ValueError(
+                f"low watermark {self.low_pressure} must be below high "
+                f"{self.high_pressure}"
+            )
+        if self.up_ticks < 1 or self.down_ticks < 1:
+            raise ValueError("up_ticks and down_ticks must be >= 1")
+
+
+class FleetController:
+    """Add/drain replicas from the ``serve.fleet_pressure`` signal.
+
+    ``factory(rid)`` builds a NEW (unstarted) :class:`Replica`; the
+    controller starts it through ``Router.add_replica``. ``tick()``
+    returns the action taken (``"scale_up"`` / ``"drain"`` /
+    ``"remove"`` / None) so callers and tests can assert the policy.
+    """
+
+    def __init__(
+        self,
+        router: Router,
+        factory: Callable[[int], Replica],
+        config: Optional[ControllerConfig] = None,
+        *,
+        reader: Optional[Callable[[], Optional[float]]] = None,
+        snapshot_path: Optional[str] = None,
+        threaded_replicas: bool = True,
+    ) -> None:
+        self.router = router
+        self.factory = factory
+        self.config = config or ControllerConfig()
+        self.config.validate()
+        self._reader = reader
+        self.snapshot_path = snapshot_path
+        self.threaded_replicas = threaded_replicas
+        self._hot = 0
+        self._cold = 0
+        self.actions: List[Dict[str, Any]] = []
+
+    # -- signal ------------------------------------------------------------
+
+    def read_pressure(self) -> Optional[float]:
+        if self._reader is not None:
+            return self._reader()
+        if self.snapshot_path:
+            from distributeddeeplearning_tpu.obs.rollup import read_snapshot
+
+            snap = read_snapshot(self.snapshot_path)
+            if snap:
+                g = (snap.get("gauges") or {}).get("serve.fleet_pressure")
+                if g and g.get("value") is not None:
+                    return float(g["value"])
+            return None
+        return float(self.router.last_pressure)
+
+    # -- policy ------------------------------------------------------------
+
+    def _ready_count(self) -> int:
+        return sum(
+            1 for r in self.router.replicas
+            if r.state in ("starting", "ready")
+        )
+
+    def tick(self) -> Optional[str]:
+        """One control decision. Finalizes any replica that finished
+        draining (remove), then applies the watermark hysteresis."""
+        # Finalize drains the policy started earlier.
+        for r in list(self.router.replicas):
+            if r.state == "drained":
+                self.router.remove_replica(r.rid)
+                self._record("remove", r.rid)
+                return "remove"
+        p = self.read_pressure()
+        if p is None:
+            return None
+        cfg = self.config
+        if p >= cfg.high_pressure:
+            self._hot += 1
+            self._cold = 0
+        elif p <= cfg.low_pressure:
+            self._cold += 1
+            self._hot = 0
+        else:
+            self._hot = self._cold = 0
+        ready = self._ready_count()
+        if self._hot >= cfg.up_ticks and ready < cfg.max_replicas:
+            rid = self.router.next_rid()
+            self.router.add_replica(
+                self.factory(rid), start=True,
+                threaded=self.threaded_replicas,
+            )
+            self._hot = 0
+            self._record("scale_up", rid, pressure=p)
+            obs.point("fleet.scale_up", replica=rid, pressure=round(p, 4))
+            return "scale_up"
+        if self._cold >= cfg.down_ticks and ready > cfg.min_replicas:
+            victim = self._pick_drain_victim()
+            if victim is not None:
+                self.router.drain_replica(victim.rid)
+                self._cold = 0
+                self._record("drain", victim.rid, pressure=p)
+                obs.point(
+                    "fleet.scale_down", replica=victim.rid,
+                    pressure=round(p, 4),
+                )
+                return "drain"
+        return None
+
+    def _pick_drain_victim(self) -> Optional[Replica]:
+        """Least-loaded ready replica (fewest running + queued): the
+        cheapest drain — it finishes fastest and re-routes the least."""
+        ready = [r for r in self.router.replicas if r.state == "ready"]
+        if not ready:
+            return None
+        return min(
+            ready,
+            key=lambda r: (
+                r.server.active_count + r.server.queued_count
+                if r.server is not None else 0
+            ),
+        )
+
+    def _record(self, action: str, rid: int, **extra: Any) -> None:
+        self.actions.append({"action": action, "replica": rid, **extra})

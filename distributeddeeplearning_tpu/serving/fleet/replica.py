@@ -1,0 +1,360 @@
+"""One serving replica: a warmed SlotEngine + Server on its own pump.
+
+A :class:`Replica` is the fleet's unit of capacity — one compiled slot
+pool with its own scheduler, pumped by its own worker thread (or, in
+tests and deterministic benches, pumped inline by the router). Two
+properties make N of them composable inside one process:
+
+* **Private event stream** — each replica binds its own
+  :class:`~distributeddeeplearning_tpu.obs.bus.EventBus` (proc
+  ``p<k>-s<rid>`` → ``events-p0-s0.jsonl``, ``events-p0-s1.jsonl``, …)
+  around everything its pump runs, via the thread-local binding in
+  ``obs/bus.py``. Every existing instrumentation site — scheduler tick
+  spans, engine warmup compiles, pool gauges — lands in the replica's
+  file untouched, and the tailer / rollup / report machinery renders
+  per-replica views for free (``scripts/obs_watch.py``). With no
+  ``obs_dir`` the replica stays on the process-global bus.
+* **Lifecycle with an exit taxonomy** — ``new → starting → ready →
+  draining → drained`` plus ``faulted``/``removed``. A pump that dies
+  maps its exception onto the fault exit codes
+  (:mod:`distributeddeeplearning_tpu.faults`): a
+  ``NonFiniteLossError``-style deterministic failure is non-retryable
+  (121 — rejoining would replay it), anything else classifies as a
+  retryable crash (125), and :meth:`Replica.retryable` is exactly
+  ``classify_exit(rc).retryable`` — the same table the restart
+  supervisor uses. The router re-routes a faulted replica's work; a
+  retryable replica may :meth:`rejoin` (rebuilding its engine — a
+  faulted pool's device state is not trusted).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.faults import (
+    EXIT_HUNG,
+    EXIT_NONFINITE,
+    classify_exit,
+)
+from distributeddeeplearning_tpu.serving.engine import ReqSpec, SlotEngine
+from distributeddeeplearning_tpu.serving.scheduler import (
+    Request,
+    RequestHandle,
+    ServeConfig,
+    Server,
+)
+from distributeddeeplearning_tpu.utils.logging import get_logger
+
+#: Lifecycle states (docs/SERVING.md fleet section).
+STATES = (
+    "new", "starting", "ready", "draining", "drained", "faulted", "removed",
+)
+
+
+def _proc_tag(rid: int) -> str:
+    """The replica's event-stream identity: the process's own proc tag
+    (``DDL_PROCESS_ID`` + any supervisor ``OBS_PROC_SUFFIX`` restart
+    suffix) with ``-s<rid>`` appended — ``events-p0-s1.jsonl``. The
+    tailer treats it as just another part file; the rollup's per-proc
+    view keys on it."""
+    base = f"p{int(os.environ.get('DDL_PROCESS_ID', '0'))}"
+    base += os.environ.get("OBS_PROC_SUFFIX", "")
+    return f"{base}-s{rid}"
+
+
+class Replica:
+    """One SlotEngine + Server behind a private pump and event stream.
+
+    ``model``/``params`` are shared host-side across replicas (the
+    engine device-puts or reuses committed arrays); every replica
+    compiles its own closed program set at :meth:`start` and keeps the
+    zero-recompile invariant independently (``engine.compile_count ==
+    engine.programs_expected`` for its lifetime).
+    """
+
+    def __init__(
+        self,
+        rid: int,
+        model,
+        params,
+        config: Optional[ServeConfig] = None,
+        *,
+        max_len: Optional[int] = None,
+        obs_dir: Optional[str] = None,
+        run_id: Optional[str] = None,
+        idle_sleep_s: float = 0.001,
+    ) -> None:
+        self.rid = int(rid)
+        self.model = model
+        self.params = params
+        self.config = config or ServeConfig()
+        self.max_len = max_len
+        self.obs_dir = obs_dir
+        self.run_id = run_id
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.state = "new"
+        self.threaded = True
+        self.engine: Optional[SlotEngine] = None
+        self.server: Optional[Server] = None
+        self.bus: Optional[obs.EventBus] = None
+        self.fault: Optional[BaseException] = None
+        self.exit_code: Optional[int] = None
+        self.dispatched = 0  # requests this replica was handed
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # Set by Router.fail_replica: the pump must NOT gracefully
+        # drain on stop — the router is taking the work elsewhere.
+        self._abandon = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, threaded: bool = True) -> "Replica":
+        """Build + warm the engine and begin pumping. ``threaded=False``
+        builds inline and leaves pumping to :meth:`pump_once` (the
+        router's deterministic single-thread mode for tests)."""
+        if self.state not in ("new", "drained", "faulted", "removed"):
+            raise RuntimeError(f"replica {self.rid} is {self.state}")
+        self.threaded = threaded
+        self.state = "starting"
+        self._stop.clear()
+        if self.bus is None and self.obs_dir:
+            self.bus = obs.EventBus(
+                directory=self.obs_dir,
+                run_id=self.run_id or obs.get_bus().run_id,
+                proc=_proc_tag(self.rid),
+            )
+        if threaded:
+            self._thread = threading.Thread(
+                target=self._worker, name=f"replica-{self.rid}", daemon=True
+            )
+            self._thread.start()
+        else:
+            with obs.bound_bus(self.bus):
+                self._build()
+            self.state = "ready"
+        return self
+
+    def _build(self) -> None:
+        if self.engine is not None:
+            return
+        kw = dict(self.config.engine_kwargs())
+        if self.max_len is not None:
+            kw.setdefault("max_len", self.max_len)
+        engine = SlotEngine(self.model, self.params, **kw)
+        engine.warmup()
+        self.engine = engine
+        self.server = Server(
+            engine,
+            queue_depth=self.config.queue_depth,
+            prefills_per_step=self.config.prefills_per_step,
+            default_deadline_ms=self.config.deadline_ms,
+            admission_policy=self.config.build_admission_policy(),
+        )
+        obs.point("fleet.replica_ready", replica=self.rid)
+
+    def _worker(self) -> None:
+        obs.bind_bus(self.bus)
+        try:
+            self._build()
+            if self.state == "starting":  # a drain may already be asked
+                self.state = "ready"
+            while not self._stop.is_set():
+                if not self.server.step():
+                    if self.state == "draining":
+                        break  # empty while draining: done
+                    time.sleep(self.idle_sleep_s)
+            # stop requested with work possibly remaining: finish it —
+            # a stopping replica never drops admitted work (the router
+            # reclaims *queued* requests before stopping a pump) —
+            # unless the router declared this replica failed and is
+            # re-routing everything it holds (_abandon).
+            if not self._abandon.is_set():
+                self.server.drain()
+                if self.state in ("draining", "ready", "starting"):
+                    self.state = "drained"
+                    obs.point("fleet.replica_drained", replica=self.rid)
+        except BaseException as e:  # the pump is a thread main: classify
+            self.fault = e
+            code = e.code if isinstance(e, SystemExit) and isinstance(
+                getattr(e, "code", None), int
+            ) else EXIT_HUNG  # generic crash: retryable class
+            if type(e).__name__ == "NonFiniteLossError":
+                code = EXIT_NONFINITE
+            self.exit_code = int(code)
+            self.state = "faulted"
+            get_logger().error(
+                "replica %d faulted (%s): %r", self.rid,
+                classify_exit(self.exit_code).reason, e,
+            )
+            obs.point(
+                "fleet.replica_fault", replica=self.rid, error=repr(e),
+                exit_code=self.exit_code,
+                retryable=classify_exit(self.exit_code).retryable,
+            )
+        finally:
+            if self.bus is not None:
+                self.bus.flush()
+            obs.bind_bus(None)
+
+    def pump_once(self) -> bool:
+        """Inline pump (unthreaded replicas): one scheduler tick on the
+        caller's thread, with this replica's event stream bound. A
+        pump-side exception faults the replica exactly like the worker
+        path (the router then re-routes its work)."""
+        if self.server is None or self.state not in ("ready", "draining"):
+            return False
+        try:
+            with obs.bound_bus(self.bus):
+                busy = self.server.step()
+        except BaseException as e:
+            self.fault = e
+            self.exit_code = EXIT_HUNG
+            self.state = "faulted"
+            obs.point(
+                "fleet.replica_fault", replica=self.rid, error=repr(e),
+                exit_code=self.exit_code, retryable=True,
+            )
+            return False
+        if not busy and self.state == "draining":
+            self.state = "drained"
+            obs.point("fleet.replica_drained", replica=self.rid)
+        return busy
+
+    def begin_drain(self) -> None:
+        """Stop taking placements; finish what is running. The router
+        reclaims this replica's *queued* requests — see
+        ``Router.drain_replica`` — so only in-flight slots remain, and
+        the pump parks the state at ``drained`` once they finish."""
+        if self.state in ("ready", "starting"):
+            self.state = "draining"
+            obs.point("fleet.replica_drain", replica=self.rid)
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop the pump thread (drains admitted work first)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def retryable(self) -> bool:
+        """May this replica rejoin after a fault? — the supervisor's
+        exit-code table (``faults.classify_exit``). A non-faulted
+        replica is always rejoinable."""
+        if self.exit_code is None:
+            return True
+        return classify_exit(self.exit_code).retryable
+
+    def rejoin(self, threaded: Optional[bool] = None) -> "Replica":
+        """Bring a drained/faulted replica back into service. A faulted
+        replica's engine is rebuilt from scratch (its device pool and
+        host mirrors are not trusted after an arbitrary pump death); a
+        cleanly drained one reuses its warmed programs. Non-retryable
+        faults (deterministic failures) refuse — restarting would
+        replay them."""
+        if self.state not in ("drained", "faulted", "removed"):
+            raise RuntimeError(f"replica {self.rid} is {self.state}")
+        if not self.retryable:
+            raise RuntimeError(
+                f"replica {self.rid} fault is non-retryable "
+                f"(exit {self.exit_code}: "
+                f"{classify_exit(self.exit_code).reason})"
+            )
+        if self.state == "faulted":
+            self.engine = None
+            self.server = None
+        self.fault = None
+        self.exit_code = None
+        self._abandon.clear()
+        obs.point("fleet.replica_rejoin", replica=self.rid)
+        return self.start(
+            threaded=self.threaded if threaded is None else threaded
+        )
+
+    # -- placement inputs --------------------------------------------------
+
+    @property
+    def placeable(self) -> bool:
+        return self.state == "ready" and self.server is not None
+
+    def free_slot_count(self) -> int:
+        if self.engine is None:
+            return 0
+        # Slots not occupied AND not already promised to queued requests
+        # the pump will admit on its next ticks — keeps replica queues
+        # shallow so a drain has almost nothing to re-route.
+        free = self.engine.num_slots - self.server.active_count
+        return max(free - self.server.queued_count, 0)
+
+    def load(self) -> Dict[str, float]:
+        """Placement score inputs: free-slot and free-block fractions."""
+        if self.engine is None:
+            return {"free_slots": 0.0, "free_blocks": 1.0}
+        free_slots = self.free_slot_count() / max(self.engine.num_slots, 1)
+        free_blocks = 1.0
+        if self.engine.allocator is not None:
+            a = self.engine.allocator
+            free_blocks = a.free_count / max(a.capacity, 1)
+        return {"free_slots": free_slots, "free_blocks": free_blocks}
+
+    def prefix_hit_blocks(self, prompt: np.ndarray) -> int:
+        """How many leading KV blocks of ``prompt`` this replica's
+        allocator already holds (0 on dense / prefix-cache-off) — the
+        affinity tier's routing signal."""
+        if (
+            self.engine is None
+            or self.engine.allocator is None
+            or not self.engine.prefix_cache
+        ):
+            return 0
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        return self.engine.allocator.peek_prefix(p, p.shape[0] - 1)
+
+    def can_take(self, spec: ReqSpec) -> bool:
+        return (
+            self.placeable
+            and self.free_slot_count() > 0
+            and self.engine.can_admit(spec)
+        )
+
+    def submit(self, request: Request) -> RequestHandle:
+        """Submit into this replica's server, on its event stream."""
+        with obs.bound_bus(self.bus):
+            handle = self.server.submit(request)
+        self.dispatched += 1
+        return handle
+
+    def reclaim_queued(self) -> List[RequestHandle]:
+        with obs.bound_bus(self.bus):
+            return self.server.reclaim_queued() if self.server else []
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One row of the router's fleet view."""
+        out: Dict[str, Any] = {"replica": self.rid, "state": self.state}
+        if self.server is not None:
+            out.update(
+                active=self.server.active_count,
+                queued=self.server.queued_count,
+                dispatched=self.dispatched,
+                completed=self.server.stats["completed"],
+                tokens=self.server.stats["tokens"],
+            )
+        if self.engine is not None:
+            out.update(
+                slots=self.engine.num_slots,
+                occupancy=self.engine.occupancy,
+                programs=self.engine.compile_count,
+                programs_expected=self.engine.programs_expected,
+            )
+            if self.engine.allocator is not None:
+                out["free_blocks"] = self.engine.allocator.free_count
+        if self.exit_code is not None:
+            out["exit_code"] = self.exit_code
+            out["retryable"] = self.retryable
+        return out

@@ -1,0 +1,770 @@
+"""Fleet router: per-tenant fair admission over N serving replicas.
+
+The front door of the multi-replica serving tier (docs/SERVING.md).
+Clients submit tenant-tagged requests; the router owns everything
+between submission and a replica's slot pool:
+
+* **Deficit-weighted fair queueing** — one bounded queue per tenant,
+  served by token-cost deficit round robin: each dispatch round every
+  backlogged tenant banks ``quantum × weight`` deficit and may dispatch
+  requests while its deficit covers their ``max_new_tokens`` cost. A
+  hot tenant flooding the fleet cannot starve a weight-1 neighbour:
+  the neighbour banks deficit every round and dispatches as soon as one
+  request's cost is covered, and completed-token shares track weight
+  shares under contention (the fleet bench's fairness gate). An idle
+  tenant banks nothing (classic DRR — no credit hoarding).
+* **Placement** — among ``ready`` replicas that can admit the request
+  (free slot, free KV blocks): a **prefix-affinity tier** first
+  (``SERVE_PLACEMENT=affinity``, default): requests whose prompt shares
+  a block-aligned cached prefix route to the replica whose
+  BlockAllocator already holds those blocks (prefill then computes only
+  the divergent suffix); ties and affinity-less requests fall to
+  **least-loaded** (free-slot + free-block fraction); ``load`` skips
+  the affinity tier, ``rr`` round-robins (the A/B control).
+* **Health / drain / rejoin** — :meth:`drain_replica` stops placement
+  and reclaims the replica's queued requests back into the tenant
+  queues (front, original submit order); running streams finish on the
+  replica. A **faulted** replica's queued *and* running requests
+  re-route: per-request determinism (the serving tier's bitwise-parity
+  contract) means a from-scratch restart on another replica replays the
+  identical stream, so the fleet handle splices at the exact token
+  where delivery stopped — zero drops, zero duplicates, oracle-tested.
+  Rejoin eligibility follows the faults exit taxonomy
+  (``faults.classify_exit`` — deterministic failures don't rejoin).
+* **Streaming** — tokens flow to :class:`FleetHandle` the moment a
+  replica commits them (``Request.on_token`` push), so ``stream()`` /
+  client callbacks see a true incremental stream and TTFT is a real
+  first-token measurement end to end, queueing and routing included.
+* **Autoscale signal** — every router tick publishes
+  ``serve.fleet_pressure`` (demanded slots / ready slots, and KV-block
+  saturation on paged fleets) plus ``serve.fleet_replicas`` /
+  ``serve.fleet_queued`` / ``serve.fleet_active`` gauges; a
+  :class:`~distributeddeeplearning_tpu.serving.fleet.controller.FleetController`
+  consumes the signal between ticks to add or drain replicas.
+
+Env contract (:meth:`FleetConfig.from_env`, docs/ORCHESTRATION.md):
+``SERVE_REPLICAS``, ``SERVE_TENANT_WEIGHTS`` (``name:weight,…``),
+``SERVE_PLACEMENT`` (``affinity`` | ``load`` | ``rr``),
+``SERVE_FLEET_QUEUE_DEPTH``, ``SERVE_FLEET_QUANTUM``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.serving.fleet.replica import Replica
+from distributeddeeplearning_tpu.serving.scheduler import (
+    QueueFull,
+    Request,
+    RequestHandle,
+    ServeConfig,
+)
+
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Fleet-level knobs, env-overridable (SERVE_* — docs/SERVING.md).
+    Per-replica engine/scheduler knobs stay on :class:`ServeConfig`."""
+
+    replicas: int = 2
+    tenant_weights: Optional[Dict[str, float]] = None
+    placement: str = "affinity"
+    queue_depth: int = 1024
+    # DRR quantum: deficit banked per weight unit per fresh cursor
+    # visit, in token-cost units (a request costs its max_new_tokens).
+    # Smaller = finer-grained interleave (smoother fairness at the cost
+    # of more cursor cycles); a weight-1 tenant still always progresses
+    # — it banks every visit and dispatches once its deficit covers one
+    # request.
+    quantum: int = 16
+
+    @classmethod
+    def from_env(cls, env=None) -> "FleetConfig":
+        e = os.environ if env is None else env
+        weights = None
+        if e.get("SERVE_TENANT_WEIGHTS"):
+            weights = parse_tenant_weights(e["SERVE_TENANT_WEIGHTS"])
+        return cls(
+            replicas=int(e.get("SERVE_REPLICAS", cls.replicas)),
+            tenant_weights=weights,
+            placement=str(e.get("SERVE_PLACEMENT", cls.placement)),
+            queue_depth=int(
+                e.get("SERVE_FLEET_QUEUE_DEPTH", cls.queue_depth)
+            ),
+            quantum=int(e.get("SERVE_FLEET_QUANTUM", cls.quantum)),
+        )
+
+    def validate(self) -> None:
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.placement not in ("affinity", "load", "rr"):
+            raise ValueError(
+                f"SERVE_PLACEMENT must be affinity|load|rr, got "
+                f"{self.placement!r}"
+            )
+        if self.queue_depth < 1 or self.quantum < 1:
+            raise ValueError("queue_depth and quantum must be >= 1")
+        for t, w in (self.tenant_weights or {}).items():
+            if w <= 0:
+                raise ValueError(f"tenant {t!r} weight must be > 0, got {w}")
+
+
+def parse_tenant_weights(text: str) -> Dict[str, float]:
+    """``"a:3,b:1.5,c:1"`` → ``{"a": 3.0, "b": 1.5, "c": 1.0}`` (bare
+    ``"a"`` means weight 1)."""
+    out: Dict[str, float] = {}
+    for part in str(text).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        out[name.strip()] = float(w) if w.strip() else 1.0
+    return out
+
+
+class _Tenant:
+    """One tenant's DRR lane: weight, FIFO backlog, banked deficit."""
+
+    __slots__ = ("name", "weight", "queue", "deficit", "tokens_done",
+                 "completed")
+
+    def __init__(self, name: str, weight: float) -> None:
+        self.name = name
+        self.weight = float(weight)
+        self.queue: Deque["FleetHandle"] = collections.deque()
+        self.deficit = 0.0
+        self.tokens_done = 0
+        self.completed = 0
+
+
+class FleetHandle:
+    """Client-side view of one fleet request — survives re-routing.
+
+    The underlying per-replica :class:`RequestHandle` is an *attempt*;
+    this handle splices attempts into one exact stream: tokens already
+    delivered are never re-emitted, and a restarted attempt's replay
+    (identical by the per-request determinism contract) is verified
+    token-for-token against the delivered prefix
+    (``restart_consistent``). API mirrors :class:`RequestHandle`:
+    ``tokens`` / ``result()`` / ``stream()`` / ``cancel()``.
+    """
+
+    def __init__(self, request: Request, tenant: str, fid: int,
+                 now: float) -> None:
+        self.request = request
+        self.tenant = tenant
+        self.id = fid
+        self.status = "queued"
+        self.finish_reason: Optional[str] = None
+        self.new_tokens: List[int] = []
+        self.submitted_t = now
+        self.ttft_s: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.done = threading.Event()
+        self.replica_id: Optional[int] = None
+        self.attempts = 0
+        self.restart_consistent = True
+        self._cond = threading.Condition()
+        self._cancel = False
+        self._client_cb = request.on_token
+        self._sub: Optional[RequestHandle] = None
+        self._sub_seen = 0  # tokens ingested from the CURRENT attempt
+        self._deadline_t = (
+            now + request.deadline_ms / 1e3
+            if request.deadline_ms is not None else None
+        )
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return np.concatenate([
+            np.asarray(self.request.prompt, np.int32).reshape(-1),
+            np.asarray(self.new_tokens, np.int32),
+        ])
+
+    def cancel(self) -> None:
+        self._cancel = True
+        sub = self._sub
+        if sub is not None:
+            sub.cancel()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still {self.status}")
+        return self.tokens
+
+    def stream(self, timeout: Optional[float] = None):
+        """Incremental token iterator across attempts — yields each
+        token exactly once, in order, whatever re-routing happened
+        underneath (``RequestHandle.stream`` semantics otherwise)."""
+        i = 0
+        while True:
+            with self._cond:
+                while i >= len(self.new_tokens) and not self.done.is_set():
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError(
+                            f"request {self.id}: no token within {timeout}s"
+                        )
+                fresh = self.new_tokens[i:]
+            for tok in fresh:
+                yield int(tok)
+            i += len(fresh)
+            if self.done.is_set() and i >= len(self.new_tokens):
+                return
+
+    def expired(self, now: float) -> bool:
+        return self._deadline_t is not None and now > self._deadline_t
+
+    # -- router side -------------------------------------------------------
+
+    def _attach(self, sub: RequestHandle, replica_id: int) -> None:
+        self._sub = sub
+        self._sub_seen = 0
+        self.replica_id = replica_id
+        self.attempts += 1
+        self.status = "running"
+
+    def _detach(self) -> None:
+        self._sub = None
+        self._sub_seen = 0
+        self.replica_id = None
+        self.status = "queued"
+
+    def _ingest(self, toks: List[int]) -> None:
+        """Splice one attempt's delivery into the fleet stream. Called
+        from the replica's serving thread (via ``Request.on_token``)."""
+        fresh: List[int] = []
+        with self._cond:
+            start = self._sub_seen
+            self._sub_seen += len(toks)
+            for j, tok in enumerate(toks):
+                gi = start + j
+                if gi < len(self.new_tokens):
+                    # Replay of an already-delivered prefix (post-fault
+                    # restart): determinism says it must match.
+                    if self.new_tokens[gi] != int(tok):
+                        self.restart_consistent = False
+                else:
+                    self.new_tokens.append(int(tok))
+                    fresh.append(int(tok))
+            if fresh and self.ttft_s is None:
+                self.ttft_s = time.monotonic() - self.submitted_t
+            if fresh:
+                self._cond.notify_all()
+        if not self.restart_consistent:
+            obs.point("fleet.restart_divergence", req=self.id)
+        if fresh and self._client_cb is not None:
+            try:
+                self._client_cb(self, fresh)
+            except Exception as e:
+                obs.point(
+                    "serve.stream_callback_error", req=self.id, error=repr(e)
+                )
+
+    def _finish(self, reason: str) -> None:
+        self.status = "done" if reason in ("eos", "length") else reason
+        self.finish_reason = reason
+        self.finished_t = time.monotonic()
+        with self._cond:
+            self.done.set()
+            self._cond.notify_all()
+
+
+class Router:
+    """The fleet front end: tenant queues → placement → replicas.
+
+    Single-pumper model like :class:`Server`: one thread drives
+    :meth:`step` / :meth:`drain` / :meth:`serve_forever`; ``submit`` /
+    ``cancel`` are safe from any thread. Replica pumps are their own
+    threads (``Replica.start(threaded=True)``) or are pumped inline by
+    :meth:`step` (deterministic tests).
+    """
+
+    def __init__(
+        self,
+        replicas: Optional[List[Replica]] = None,
+        *,
+        config: Optional[FleetConfig] = None,
+    ) -> None:
+        self.config = config or FleetConfig()
+        self.config.validate()
+        self.replicas: List[Replica] = []
+        self._tenants: Dict[str, _Tenant] = {}
+        for name, w in (self.config.tenant_weights or {}).items():
+            self._tenants[name] = _Tenant(name, w)
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._inflight: List[FleetHandle] = []
+        self._rr_cursor = 0
+        self._drr_cursor = 0
+        self._drr_fresh = True
+        self._closed = False
+        self.last_pressure = 0.0
+        self.stats: Dict[str, Any] = {
+            "submitted": 0, "dispatched": 0, "requeued": 0, "completed": 0,
+            "rejected": 0, "cancelled": 0, "deadline": 0,
+        }
+        for r in replicas or []:
+            self.add_replica(r, start=False)
+
+    # -- fleet membership --------------------------------------------------
+
+    def add_replica(self, replica: Replica, *, start: bool = True,
+                    threaded: bool = True) -> Replica:
+        """Register (and by default start) one replica."""
+        self.replicas.append(replica)
+        obs.point("fleet.replica_add", replica=replica.rid)
+        if start and replica.state == "new":
+            replica.start(threaded=threaded)
+        return replica
+
+    def _replica(self, rid: int) -> Replica:
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        raise KeyError(f"no replica {rid}")
+
+    def next_rid(self) -> int:
+        """A fresh replica id (controller scale-up)."""
+        return max((r.rid for r in self.replicas), default=-1) + 1
+
+    def drain_replica(self, rid: int) -> int:
+        """Graceful drain: stop placing onto ``rid``, pull its queued
+        requests back into the tenant queues (front — they keep their
+        place), let running streams finish there. Returns the number of
+        requests re-routed."""
+        replica = self._replica(rid)
+        replica.begin_drain()
+        return self._requeue_from(replica, running_too=False)
+
+    def fail_replica(self, rid: int, error: Optional[BaseException] = None
+                     ) -> int:
+        """Treat ``rid`` as faulted NOW (health probe / operator):
+        stop its pump and re-route queued AND running requests."""
+        replica = self._replica(rid)
+        replica._abandon.set()  # do not drain: we re-route instead
+        replica.stop(timeout=5.0)
+        if replica.state != "faulted":
+            replica.state = "faulted"
+            replica.fault = error
+            from distributeddeeplearning_tpu.faults import EXIT_HUNG
+
+            replica.exit_code = EXIT_HUNG
+            obs.point(
+                "fleet.replica_fault", replica=rid,
+                error=repr(error) if error else "declared_failed",
+                exit_code=replica.exit_code, retryable=True,
+            )
+        return self._requeue_from(replica, running_too=True)
+
+    def remove_replica(self, rid: int) -> Replica:
+        """Take a drained/faulted replica out of the fleet (its queued
+        and — when faulted — running work must already be re-routed;
+        this asserts that, it does not silently drop)."""
+        replica = self._replica(rid)
+        if replica.state not in ("drained", "faulted", "removed"):
+            raise RuntimeError(
+                f"replica {rid} is {replica.state}; drain or fail it first"
+            )
+        if replica.server is not None and (
+            replica.server.queued_count
+            or (replica.state == "faulted" and replica.server.active_count)
+        ):
+            raise RuntimeError(
+                f"replica {rid} still holds un-rerouted requests"
+            )
+        replica.stop(timeout=5.0)
+        replica.state = "removed"
+        self.replicas = [r for r in self.replicas if r.rid != rid]
+        obs.point("fleet.replica_remove", replica=rid)
+        return replica
+
+    def rejoin_replica(self, replica_or_rid, *, threaded: Optional[bool]
+                       = None) -> Replica:
+        """Bring a drained/faulted/removed replica back into rotation
+        (``Replica.rejoin`` rules: non-retryable faults refuse)."""
+        replica = (
+            replica_or_rid if isinstance(replica_or_rid, Replica)
+            else self._replica(replica_or_rid)
+        )
+        replica.rejoin(threaded=threaded)
+        if replica not in self.replicas:
+            self.replicas.append(replica)
+        return replica
+
+    def _requeue_from(self, replica: Replica, *, running_too: bool) -> int:
+        """Reclaim a replica's requests and put them back at the front
+        of their tenant queues, preserving relative submit order."""
+        subs = replica.reclaim_queued()
+        if running_too and replica.server is not None:
+            subs += replica.server.take_running()
+        moved = 0
+        with self._lock:
+            sub_ids = {id(s) for s in subs}
+            victims = [
+                fh for fh in self._inflight
+                if fh._sub is not None and id(fh._sub) in sub_ids
+            ]
+            # oldest first so appendleft() restores submit order
+            for fh in sorted(victims, key=lambda f: f.id, reverse=True):
+                self._inflight.remove(fh)
+                fh._detach()
+                self._tenant(fh.tenant).queue.appendleft(fh)
+                moved += 1
+                self.stats["requeued"] += 1
+        if moved:
+            obs.counter("fleet.requeued", moved, replica=replica.rid)
+        return moved
+
+    # -- client side -------------------------------------------------------
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _Tenant(name, 1.0)
+        return t
+
+    def set_tenant_weight(self, name: str, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        self._tenant(name).weight = float(weight)
+
+    def submit(self, request: Request, tenant: str = DEFAULT_TENANT
+               ) -> FleetHandle:
+        """Enqueue one tenant-tagged request. Backpressure
+        (:class:`QueueFull`) when the fleet-wide backlog is at
+        capacity. Validation is eager against any ready replica so a
+        malformed request fails the caller, not the dispatch loop."""
+        if self._closed:
+            raise RuntimeError("router is closed")
+        for r in self.replicas:
+            if r.placeable:
+                r.engine.validate_spec(request.spec())
+                break
+        now = time.monotonic()
+        with self._lock:
+            backlog = sum(len(t.queue) for t in self._tenants.values())
+            if backlog >= self.config.queue_depth:
+                self.stats["rejected"] += 1
+                obs.counter("serve.rejected", tenant=tenant)
+                raise QueueFull(
+                    f"fleet queue at capacity ({self.config.queue_depth})"
+                )
+            fh = FleetHandle(request, tenant, next(self._ids), now)
+            self._tenant(tenant).queue.append(fh)
+            self.stats["submitted"] += 1
+        obs.counter("fleet.submitted", tenant=tenant)
+        return fh
+
+    # -- pump --------------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """One router tick: health sweep → finish sweep → DRR dispatch
+        → inline replica pumps → fleet gauges. Returns True while work
+        remains anywhere in the fleet."""
+        now = time.monotonic() if now is None else now
+        self._health_sweep()
+        self._finish_sweep()
+        self._dispatch(now)
+        busy = False
+        for r in self.replicas:
+            if not r.threaded:
+                busy = r.pump_once() or busy
+        self._finish_sweep()
+        with self._lock:
+            backlog = sum(len(t.queue) for t in self._tenants.values())
+            inflight = len(self._inflight)
+        self._emit_gauges(backlog, inflight)
+        return bool(backlog or inflight or busy)
+
+    def _health_sweep(self) -> None:
+        for r in list(self.replicas):
+            if r.state == "faulted" and (
+                r.server is not None
+                and (r.server.queued_count or r.server.active_count)
+            ):
+                # the pump is dead: reclaim everything it held
+                self._requeue_from(r, running_too=True)
+
+    def _finish_sweep(self) -> None:
+        with self._lock:
+            inflight = list(self._inflight)
+        for fh in inflight:
+            sub = fh._sub
+            if sub is None:
+                continue
+            if sub.status == "requeued":
+                # reclaim raced us (drain path) — the requeue already
+                # moved fh back to its tenant queue; nothing to do here.
+                continue
+            if not sub.done.is_set():
+                continue
+            reason = sub.finish_reason or "done"
+            with self._lock:
+                if fh in self._inflight:
+                    self._inflight.remove(fh)
+            t = self._tenant(fh.tenant)
+            if reason in ("eos", "length"):
+                t.completed += 1
+                t.tokens_done += len(fh.new_tokens)
+                self.stats["completed"] += 1
+                obs.counter("fleet.completed", tenant=fh.tenant)
+                obs.counter(
+                    "fleet.tenant_tokens", len(fh.new_tokens),
+                    tenant=fh.tenant,
+                )
+            else:
+                key = "cancelled" if reason == "cancelled" else "deadline"
+                self.stats[key] += 1
+            fh._finish(reason)
+
+    def _reap_queued(self, t: _Tenant, now: float) -> None:
+        finished: List = []
+        with self._lock:  # submit() appends under the same lock
+            keep: Deque[FleetHandle] = collections.deque()
+            for fh in t.queue:
+                if fh._cancel:
+                    finished.append((fh, "cancelled"))
+                elif fh.expired(now):
+                    finished.append((fh, "deadline"))
+                else:
+                    keep.append(fh)
+            t.queue = keep
+        for fh, reason in finished:
+            key = "cancelled" if reason == "cancelled" else "deadline"
+            self.stats[key] += 1
+            obs.counter(
+                "serve.cancelled" if reason == "cancelled"
+                else "serve.evicted_deadline",
+                tenant=t.name,
+            )
+            fh._finish(reason)
+
+    def _dispatch(self, now: float) -> None:
+        """Deficit round robin with a cursor that persists across ticks.
+
+        Classic DRR semantics (the properties the fairness oracle
+        pins): the cursor banks ``quantum × weight`` exactly once per
+        *fresh visit* to a backlogged tenant, serves that tenant until
+        its deficit no longer covers the head request's token cost (or
+        its queue empties), then advances. Crucially, when fleet
+        capacity runs out **mid-service**, the cursor stays put and
+        resumes the same tenant — without banking again — on the next
+        tick; otherwise a fleet whose slots free up one at a time would
+        hand every trickle slot to whichever tenant the scan happened
+        to start at, and weights would stop meaning anything. A tenant
+        that empties its queue forfeits its deficit (no credit
+        hoarding while idle)."""
+        with self._lock:
+            tenants = sorted(self._tenants.values(), key=lambda t: t.name)
+        for t in tenants:
+            self._reap_queued(t, now)
+        if not any(t.queue for t in tenants):
+            for t in tenants:
+                t.deficit = 0.0
+            return
+        capacity = sum(
+            r.free_slot_count() for r in self.replicas if r.placeable
+        )
+        idle_visits = 0
+        while capacity > 0 and idle_visits <= len(tenants):
+            t = tenants[self._drr_cursor % len(tenants)]
+            if not t.queue:
+                t.deficit = 0.0
+                self._drr_cursor += 1
+                self._drr_fresh = True
+                idle_visits += 1
+                continue
+            if self._drr_fresh:
+                t.deficit += self.config.quantum * t.weight
+                self._drr_fresh = False
+            served = 0
+            blocked = False
+            while t.queue and capacity > 0:
+                fh = t.queue[0]
+                cost = float(fh.request.max_new_tokens)
+                if t.deficit < cost:
+                    break
+                replica = self._place(fh)
+                if replica is None:
+                    blocked = True  # no replica can admit this request
+                    break
+                with self._lock:
+                    t.queue.popleft()
+                t.deficit -= cost
+                self._dispatch_to(replica, fh)
+                capacity -= 1
+                served += 1
+            if capacity <= 0 and t.queue and not blocked:
+                return  # resume THIS tenant next tick (cursor stays)
+            # service ended on its own terms: move on
+            if not t.queue:
+                t.deficit = 0.0
+            self._drr_cursor += 1
+            self._drr_fresh = True
+            idle_visits = 0 if served else idle_visits + 1
+
+    def _place(self, fh: FleetHandle) -> Optional[Replica]:
+        spec = fh.request.spec()
+        candidates = [
+            r for r in self.replicas if r.placeable and r.can_take(spec)
+        ]
+        if not candidates:
+            return None
+        mode = self.config.placement
+        if mode == "rr":
+            self._rr_cursor += 1
+            return candidates[self._rr_cursor % len(candidates)]
+        if mode == "affinity":
+            hits = [
+                (r.prefix_hit_blocks(fh.request.prompt), r)
+                for r in candidates
+            ]
+            best = max(h for h, _ in hits)
+            if best > 0:
+                candidates = [r for h, r in hits if h == best]
+                if len(candidates) == 1:
+                    return candidates[0]
+        # least-loaded: most free capacity wins (slot + block fractions)
+        def score(r: Replica) -> float:
+            ld = r.load()
+            return ld["free_slots"] + ld["free_blocks"]
+
+        return max(candidates, key=score)
+
+    def _dispatch_to(self, replica: Replica, fh: FleetHandle) -> None:
+        req = dataclasses.replace(
+            fh.request,
+            on_token=lambda _h, toks, fh=fh: fh._ingest(toks),
+            # fleet-level deadline already tracked on the FleetHandle;
+            # the remaining budget rides to the replica so running
+            # streams still get evicted there.
+            deadline_ms=(
+                None if fh._deadline_t is None
+                else max((fh._deadline_t - time.monotonic()) * 1e3, 1.0)
+            ),
+        )
+        sub = replica.submit(req)
+        fh._attach(sub, replica.rid)
+        with self._lock:
+            self._inflight.append(fh)
+        self.stats["dispatched"] += 1
+        obs.counter("fleet.dispatched", tenant=fh.tenant,
+                    replica=replica.rid)
+
+    # -- autoscale signal --------------------------------------------------
+
+    def pressure(self) -> float:
+        """The autoscaling signal: demanded capacity over ready
+        capacity. 1.0 = the fleet's slots exactly cover current demand
+        (router backlog + replica queues + running streams); above it,
+        work is waiting; paged fleets also saturate on KV blocks
+        (whichever is scarcer). Derived from the same quantities the
+        ``serve.slot_occupancy`` / queue / block-pool rollups carry —
+        this is their fleet-level composition."""
+        ready = [r for r in self.replicas if r.placeable]
+        total_slots = sum(r.engine.num_slots for r in ready)
+        with self._lock:
+            backlog = sum(len(t.queue) for t in self._tenants.values())
+        demand = backlog + sum(
+            r.server.active_count + r.server.queued_count for r in ready
+        )
+        slot_pressure = demand / max(total_slots, 1)
+        block_pressure = 0.0
+        for r in ready:
+            if r.engine.allocator is not None:
+                a = r.engine.allocator
+                used = 1.0 - a.free_count / max(a.capacity, 1)
+                block_pressure = max(block_pressure, used)
+        return max(slot_pressure, block_pressure)
+
+    def _emit_gauges(self, backlog: int, inflight: int) -> None:
+        p = self.pressure()
+        self.last_pressure = p
+        obs.gauge("serve.fleet_pressure", round(p, 4))
+        obs.gauge(
+            "serve.fleet_replicas",
+            float(sum(1 for r in self.replicas if r.placeable)),
+        )
+        obs.gauge("serve.fleet_queued", float(backlog))
+        obs.gauge("serve.fleet_active", float(inflight))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Pump until every submitted request has finished."""
+        t0 = time.monotonic()
+        while self.step():
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError("fleet drain timed out")
+            time.sleep(0.0005)
+
+    def serve_forever(self, stop: threading.Event,
+                      idle_sleep_s: float = 0.001) -> None:
+        while not stop.is_set():
+            if not self.step():
+                time.sleep(idle_sleep_s)
+        self.drain()
+
+    def close(self) -> None:
+        """Stop accepting, drain everything, stop every replica pump."""
+        self._closed = True
+        self.drain()
+        for r in self.replicas:
+            r.stop()
+
+    def tenant_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant accounting (the fairness gate's numerator)."""
+        with self._lock:
+            return {
+                t.name: {
+                    "weight": t.weight,
+                    "queued": len(t.queue),
+                    "completed": t.completed,
+                    "tokens_done": t.tokens_done,
+                }
+                for t in self._tenants.values()
+            }
+
+    def fleet_snapshot(self) -> List[Dict[str, Any]]:
+        return [r.snapshot() for r in self.replicas]
+
+
+def build_fleet(
+    model,
+    params,
+    *,
+    fleet_config: Optional[FleetConfig] = None,
+    serve_config: Optional[ServeConfig] = None,
+    max_len: Optional[int] = None,
+    obs_dir: Optional[str] = None,
+    threaded: bool = True,
+    start: bool = True,
+) -> Router:
+    """Router + N replicas from the env-driven configs (the fleet twin
+    of ``Server.build``). ``obs_dir`` defaults to ``$OBS_DIR`` so each
+    replica lands its own ``events-p0-s<k>.jsonl`` stream whenever the
+    process is capturing events."""
+    fcfg = fleet_config or FleetConfig.from_env()
+    scfg = serve_config or ServeConfig.from_env()
+    if obs_dir is None:
+        obs_dir = os.environ.get("OBS_DIR") or None
+    router = Router(config=fcfg)
+    for k in range(fcfg.replicas):
+        router.add_replica(
+            Replica(
+                k, model, params, scfg, max_len=max_len, obs_dir=obs_dir
+            ),
+            start=start, threaded=threaded,
+        )
+    return router

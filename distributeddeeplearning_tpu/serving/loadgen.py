@@ -1,0 +1,137 @@
+"""Seeded serving load generation + warmup helpers (bench substrate).
+
+``scripts/serve_bench.py`` grew three compare protocols (paged, quant,
+spec) that each rebuilt the same seeded Poisson request stream and the
+same per-shape ``inference.generate`` warmup loop; the fleet bench
+(``scripts/fleet_bench.py``) needs both again, plus a multi-tenant
+variant. This module is the one copy:
+
+* :data:`PROFILES` / :data:`MIXED_PROMPT_LENS` — the request-shape
+  mixes (``SERVE_PROFILE``): ``mixed`` cycles a handful of prompt
+  lengths at one ``max_new``; ``longtail`` is the production-shaped
+  distribution (mostly short prompts, a thin tail of long ones) the
+  paged pool exists for.
+* :func:`build_requests` — seeded request set + Poisson arrival
+  offsets over a shape mix. Deterministic in ``seed``: every protocol
+  comparing two configurations replays the *same* load.
+* :func:`build_tenant_requests` — the same stream with a tenant
+  identity cycled over it (round-robin, so every tenant offers the
+  same work mix and a fairness bound on *completed share vs weight
+  share* is meaningful — scripts/fleet_bench.py).
+* :func:`warm_shapes` — compile/warm every distinct
+  ``(prompt_len, max_new)`` shape through ``inference.generate`` so a
+  sequential baseline measures steady-state throughput, not compiles.
+* :func:`percentile` — the nearest-rank percentile every serving bench
+  reports TTFT/queue-wait with.
+
+Pure host + numpy until :func:`warm_shapes` (the only jax touchpoint),
+so load construction stays importable from jax-free tooling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Request-shape mixes: (prompt_len, max_new) pairs cycled over the
+# request stream. "longtail" is kept to few distinct shapes so a
+# sequential baseline's per-shape warmup stays bounded.
+PROFILES: Dict[str, Optional[List[Tuple[int, int]]]] = {
+    "mixed": None,  # legacy: MIXED_PROMPT_LENS cycle, SERVE_MAX_NEW everywhere
+    "longtail": (
+        [(3, 8)] * 8 + [(4, 8)] * 6 + [(6, 8)] * 5 + [(8, 8)] * 4
+        + [(12, 16)] * 3 + [(16, 16)] * 2
+        + [(24, 16), (48, 24), (96, 32)]
+    ),
+}
+MIXED_PROMPT_LENS: Tuple[int, ...] = (4, 7, 12, 5, 16, 3, 9, 14)
+
+
+def profile_shapes(
+    profile: str, max_new: int
+) -> List[Tuple[int, int]]:
+    """The (prompt_len, max_new) mix for one ``SERVE_PROFILE`` value."""
+    if profile not in PROFILES:
+        raise ValueError(
+            f"unknown SERVE_PROFILE {profile!r} (have: {sorted(PROFILES)})"
+        )
+    shapes = PROFILES[profile]
+    if shapes is None:
+        return [(tp, max_new) for tp in MIXED_PROMPT_LENS]
+    return list(shapes)
+
+
+def percentile(vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (the serving benches' TTFT/queue-wait
+    convention; 0 on an empty sample)."""
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def build_requests(
+    n: int, rate_rps: float, seed: int, vocab: int,
+    shapes: Sequence[Tuple[int, int]],
+) -> List[Dict[str, Any]]:
+    """Seeded request set + Poisson arrival offsets (seconds) over the
+    (prompt_len, max_new) shape mix — mixed lengths, per-request
+    sampling seeds: the adversarial mix the parity oracles certify, at
+    load. ``rate_rps == 0`` is the closed-backlog special case (all
+    arrivals at t=0)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(shapes))
+    reqs = []
+    t = 0.0
+    for i in range(n):
+        if rate_rps > 0:
+            t += float(rng.exponential(1.0 / rate_rps))
+        tp, max_new = shapes[order[i % len(shapes)]]
+        reqs.append({
+            "arrival_s": t,
+            "prompt": rng.randint(0, vocab, size=(tp,)).astype(np.int32),
+            "max_new": int(max_new),
+            "seed": int(rng.randint(0, 2**31 - 1)),
+        })
+    return reqs
+
+
+def build_tenant_requests(
+    tenant_ids: Sequence[str], n: int, rate_rps: float, seed: int,
+    vocab: int, shapes: Sequence[Tuple[int, int]],
+) -> List[Dict[str, Any]]:
+    """:func:`build_requests` with a ``tenant`` identity cycled over the
+    stream. Round-robin assignment means every tenant offers the same
+    shape mix and (to within one request) the same total token work —
+    under contention, each tenant's *completed* share is then pinned by
+    the router's weights alone, which is exactly what the fairness gate
+    measures (scripts/fleet_bench.py, docs/SERVING.md)."""
+    reqs = build_requests(n, rate_rps, seed, vocab, shapes)
+    for i, r in enumerate(reqs):
+        r["tenant"] = str(tenant_ids[i % len(tenant_ids)])
+    return reqs
+
+
+def warm_shapes(
+    model, params, reqs: Sequence[Dict[str, Any]],
+    temperature: float, top_k,
+) -> int:
+    """Compile/warm every distinct (prompt_len, max_new) shape through
+    ``inference.generate`` (the sequential baseline's program set) so a
+    timed run measures steady-state throughput. Returns the number of
+    distinct shapes warmed."""
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.inference import generate
+
+    shapes = sorted({(len(r["prompt"]), r["max_new"]) for r in reqs})
+    for tp, n_new in shapes:
+        generate(
+            model, params, np.zeros((1, tp), np.int32),
+            max_new_tokens=n_new, temperature=temperature, top_k=top_k,
+            rng=jax.random.PRNGKey(0),
+        )
+    return len(shapes)

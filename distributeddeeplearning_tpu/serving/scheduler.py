@@ -297,7 +297,15 @@ class ServeConfig:
 @dataclasses.dataclass
 class Request:
     """What a client submits. ``rng`` follows ``inference.generate``:
-    raw PRNG key data, an int seed, or None (PRNGKey(0))."""
+    raw PRNG key data, an int seed, or None (PRNGKey(0)).
+
+    ``on_token``: optional streaming callback ``(handle, tokens)``
+    invoked from the serving thread the moment tokens are committed
+    (the push half of incremental streaming;
+    :meth:`RequestHandle.stream` is the pull half). It must be cheap
+    and must not raise — a raising callback is recorded as a
+    ``serve.stream_callback_error`` point and dropped, never allowed
+    to kill the serving loop."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -307,6 +315,7 @@ class Request:
     eos_token: Optional[int] = None
     rng: Any = None
     deadline_ms: Optional[float] = None
+    on_token: Any = None
 
     def spec(self) -> ReqSpec:
         return ReqSpec(
@@ -323,9 +332,12 @@ class Request:
 class RequestHandle:
     """Client-side view of one submitted request.
 
-    ``status``: queued → running → one of done / deadline / cancelled.
+    ``status``: queued → running → one of done / deadline / cancelled
+    (the fleet router may also park a reclaimed handle as ``requeued``
+    while it re-routes the request — serving/fleet/).
     ``result()`` blocks until finished and returns prompt + generated
-    tokens (up to and including eos when one was hit).
+    tokens (up to and including eos when one was hit); :meth:`stream`
+    yields tokens incrementally as the serving loop commits them.
     """
 
     def __init__(self, req: Request, req_id: int, now: float) -> None:
@@ -339,6 +351,7 @@ class RequestHandle:
         self.ttft_s: Optional[float] = None
         self.finished_t: Optional[float] = None
         self.done = threading.Event()
+        self._cond = threading.Condition()
         self._cancel = False
         self._deadline_t = (
             now + req.deadline_ms / 1e3 if req.deadline_ms is not None
@@ -359,6 +372,54 @@ class RequestHandle:
         if not self.done.wait(timeout):
             raise TimeoutError(f"request {self.id} still {self.status}")
         return self.tokens
+
+    def stream(self, timeout: Optional[float] = None):
+        """Incremental token iterator: yields each generated token (int)
+        the moment the serving loop commits it, ending when the request
+        finishes (a cancelled/deadline-evicted request ends the stream
+        after its last delivered token — the yielded prefix is still
+        exact, `tests/test_serving_fleet.py`). ``timeout`` bounds the
+        wait for EACH next token; requires a second thread pumping the
+        server (the single-pumper thread iterating its own stream would
+        deadlock)."""
+        i = 0
+        while True:
+            with self._cond:
+                while i >= len(self.new_tokens) and not self.done.is_set():
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError(
+                            f"request {self.id}: no token within {timeout}s"
+                        )
+                fresh = self.new_tokens[i:]
+            for tok in fresh:
+                yield int(tok)
+            i += len(fresh)
+            # done is sticky and new_tokens never grows after it is set,
+            # so a drained iterator can finish without holding the lock.
+            if self.done.is_set() and i >= len(self.new_tokens):
+                return
+
+    def _deliver(self, toks: List[int]) -> None:
+        """Serving-loop side: commit tokens to the handle, wake stream
+        iterators, fire the push callback. Never raises."""
+        if not toks:
+            return
+        with self._cond:
+            self.new_tokens.extend(int(t) for t in toks)
+            self._cond.notify_all()
+        cb = self.request.on_token
+        if cb is not None:
+            try:
+                cb(self, [int(t) for t in toks])
+            except Exception as e:  # client code must not kill the loop
+                obs.point(
+                    "serve.stream_callback_error", req=self.id, error=repr(e)
+                )
+
+    def _notify_done(self) -> None:
+        with self._cond:
+            self.done.set()
+            self._cond.notify_all()
 
     def expired(self, now: float) -> bool:
         return self._deadline_t is not None and now > self._deadline_t
@@ -480,7 +541,7 @@ class Server:
                 handle.ttft_s * 1e3, 3
             ),
         )
-        handle.done.set()
+        handle._notify_done()
 
     def _reap(self, now: float) -> None:
         """Deadline/cancel sweep over the queue and the active slots."""
@@ -548,7 +609,7 @@ class Server:
             handle.ttft_s = time.monotonic() - handle.submitted_t
             obs.span_event("serve.ttft", handle.ttft_s,
                            t=handle.submitted_t, req=handle.id)
-            handle.new_tokens.append(first)
+            handle._deliver([first])
             self.stats["admitted"] += 1
             self.stats["tokens"] += 1
             obs.counter("serve.admitted")
@@ -590,7 +651,7 @@ class Server:
                 h = self._by_slot.get(slot)
                 if h is None:
                     continue
-                h.new_tokens.extend(toks)
+                h._deliver(toks)
                 self.stats["tokens"] += len(toks)
                 n_tokens += len(toks)
                 if eos_hit or len(h.new_tokens) >= h.request.max_new_tokens:
@@ -631,6 +692,49 @@ class Server:
         self._closed = True
         self.drain()
 
+    # -- fleet hooks (serving/fleet/router.py) -----------------------------
+
+    def reclaim_queued(self) -> List[RequestHandle]:
+        """Pull every queued-but-not-yet-admitted request back out of
+        the server (status → ``requeued``, done NOT set) so a fleet
+        router can re-route it to another replica — the drain path's
+        zero-drop guarantee. Safe from any thread."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+            obs.gauge("serve.queue_depth", 0.0)
+        for h in out:
+            h.status = "requeued"
+        return out
+
+    def take_running(self) -> List[RequestHandle]:
+        """Evict every RUNNING request and hand its handle back (status
+        → ``requeued``) for a from-scratch restart elsewhere — the
+        *faulted*-replica path. Per-request determinism (the serving
+        tier's bitwise-parity contract) makes the restart's stream an
+        exact superset of what was already delivered, so the fleet
+        handle can splice without duplication. Only call with the pump
+        stopped (the single-pumper thread dead or parked)."""
+        out = []
+        for slot, h in list(self._by_slot.items()):
+            try:
+                self.engine.release(slot)
+            except Exception:
+                pass  # a faulted engine's bookkeeping may be wrecked
+            del self._by_slot[slot]
+            h.status = "requeued"
+            out.append(h)
+        return out
+
+    @property
+    def queued_count(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._by_slot)
+
     @property
     def occupancy_mean(self) -> float:
         n = self.stats["occupancy_samples"]
@@ -648,6 +752,7 @@ def generate_with_engine(
     eos_token: Optional[int] = None,
     pad_token: Optional[int] = None,
     rng: Any = None,
+    on_token: Any = None,
 ) -> np.ndarray:
     """``inference.generate``'s signature served by the slot engine:
     each row of ``prompt`` ([B, Tp] int32) becomes one request; rows
@@ -659,10 +764,22 @@ def generate_with_engine(
     to sequential ``generate``; rows b>0 sample under
     ``fold_in(rng, b)`` (``generate`` draws all rows from one key per
     step, which has no per-row equivalent).
+
+    ``server_or_engine`` may also be a fleet
+    :class:`~distributeddeeplearning_tpu.serving.fleet.router.Router` —
+    rows then route through the fleet (default tenant).
+
+    ``on_token``: optional incremental streaming callback
+    ``(row_index, token)`` invoked as tokens are committed — the final
+    array equals exactly the streamed tokens (oracle-tested).
     """
     from distributeddeeplearning_tpu.serving import keys as keylib
+    from distributeddeeplearning_tpu.serving.fleet.router import Router
 
-    if isinstance(server_or_engine, Server):
+    router: Optional[Router] = None
+    if isinstance(server_or_engine, Router):
+        router = server_or_engine
+    elif isinstance(server_or_engine, Server):
         server = server_or_engine
     else:
         server = Server(server_or_engine)
@@ -677,12 +794,20 @@ def generate_with_engine(
     handles = []
     for b in range(prompt.shape[0]):
         row_key = base_key if b == 0 else keylib.fold_key(base_key, b)
-        handles.append(server.submit(Request(
+        cb = None
+        if on_token is not None:
+            def cb(_h, toks, b=b):
+                for tok in toks:
+                    on_token(b, int(tok))
+        req = Request(
             prompt=prompt[b], max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_token=eos_token, rng=row_key,
-        )))
-    server.drain()
+            eos_token=eos_token, rng=row_key, on_token=cb,
+        )
+        handles.append(
+            router.submit(req) if router is not None else server.submit(req)
+        )
+    (router if router is not None else server).drain()
     out = np.full(
         (prompt.shape[0], prompt.shape[1] + max_new_tokens),
         0 if pad_token is None else pad_token, np.int32,

@@ -119,6 +119,10 @@ def analyze(
             # a regression; records predating the speculative tier ran
             # spec_k=0 and stay comparable. Same treatment as dtypes.
             "spec_k": int(detail.get("spec_k") or 0),
+            # A replica-count change re-shapes the fleet protocol the
+            # same way (aggregate throughput over N pools is a new
+            # baseline); non-fleet records normalize to 1 replica.
+            "replicas": int(detail.get("replicas") or 1),
             "skip": skip,
             "delta_pct": None,
         }
@@ -131,6 +135,7 @@ def analyze(
                 and prev["platform"] == row["platform"]
                 and prev["dtypes"] == row["dtypes"]
                 and prev["spec_k"] == row["spec_k"]
+                and prev["replicas"] == row["replicas"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -152,9 +157,14 @@ def analyze(
                     f"dtype_change:{'/'.join(prev['dtypes'])}"
                     f"->{'/'.join(row['dtypes'])}"
                 )
-            elif prev is not None:
+            elif prev is not None and prev["spec_k"] != row["spec_k"]:
                 row["skip"] = (
                     f"spec_change:k={prev['spec_k']}->k={row['spec_k']}"
+                )
+            elif prev is not None:
+                row["skip"] = (
+                    f"replica_change:{prev['replicas']}"
+                    f"->{row['replicas']}"
                 )
             if row["skip"] is None or "_change" in str(row["skip"]):
                 # A protocol/platform transition row is not COMPARED,
@@ -164,7 +174,7 @@ def analyze(
                 last[metric] = {
                     "round": e["round"], "value": value,
                     "platform": row["platform"], "dtypes": row["dtypes"],
-                    "spec_k": row["spec_k"],
+                    "spec_k": row["spec_k"], "replicas": row["replicas"],
                 }
         rows.append(row)
     return {

@@ -30,6 +30,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -54,6 +55,23 @@ def _fmt_val(v) -> str:
     if isinstance(v, float):
         return f"{v:.4g}"
     return str(v)
+
+
+#: A serving-fleet replica's private event stream (obs/bus.py
+#: bound_bus): the process proc tag with "-s<k>" appended.
+_REPLICA_PROC_RE = re.compile(r"-s\d+$")
+
+
+def replica_rows(snapshot: dict):
+    """Per-replica (stream, gauges) rows when the run carries 2+ fleet
+    replica streams (``events-p0-s<k>.jsonl``); None otherwise — a
+    single-engine run keeps the flat gauge table."""
+    procs = snapshot.get("procs") or {}
+    rows = [
+        (proc, gauges) for proc, gauges in sorted(procs.items())
+        if _REPLICA_PROC_RE.search(str(proc))
+    ]
+    return rows if len(rows) >= 2 else None
 
 
 def render(snapshot: dict) -> str:
@@ -101,15 +119,44 @@ def render(snapshot: dict) -> str:
         add(f"{'counter (window)':32s} {'sum':>10s} {'rate/s':>10s}")
         for name, c in sorted(counters.items()):
             add(f"{name:32s} {c['sum']:10.0f} {c['rate_per_s']:10.3f}")
+    replicas = replica_rows(snapshot)
     gauges = snapshot.get("gauges") or {}
     if gauges:
         add("")
         add(f"{'gauge (last value)':32s} {'value':>12s} {'age s':>8s}")
+        # With a serving fleet present, the per-replica serve gauges are
+        # rendered as rows below instead of collapsed last-writer-wins.
+        skip = (
+            {"serve.slot_occupancy", "serve.queue_depth"}
+            if replicas else set()
+        )
         for name, g in sorted(gauges.items()):
+            if name in skip:
+                continue
             age = g.get("age_s")
             add(
                 f"{name:32s} {_fmt_val(g['value']):>12s} "
                 f"{age if age is not None else '?':>8}"
+            )
+    if replicas:
+        add("")
+        add("serving replicas (one row per events-*-s<k> stream):")
+        add(
+            f"  {'stream':16s} {'occupancy':>10s} {'queue':>7s} "
+            f"{'programs':>9s} {'pool free':>10s} {'kv B/token':>11s}"
+        )
+        for proc, g in replicas:
+            def val(name, default="-"):
+                cell = g.get(name)
+                return _fmt_val(cell["value"]) if cell and cell.get(
+                    "value"
+                ) is not None else default
+            add(
+                f"  {proc:16s} {val('serve.slot_occupancy'):>10s} "
+                f"{val('serve.queue_depth'):>7s} "
+                f"{val('serve.programs'):>9s} "
+                f"{val('serve.block_pool_free'):>10s} "
+                f"{val('serve.kv_bytes_per_token'):>11s}"
             )
     return "\n".join(out)
 

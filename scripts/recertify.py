@@ -25,6 +25,10 @@ Protocols (all via bench.py's existing modes — no new measurement code):
     serve_lm_spec   serve_bench greedy-vs-speculative  tokens/sec
                     (int8 self-draft, K=4), bitwise
                     greedy parity + accept-rate stats
+    serve_lm_fleet  fleet_bench 1-vs-2 router-fronted  tokens/sec
+                    replicas, multi-tenant closed
+                    backlog: scaling + flat TTFT +
+                    weighted fairness + bitwise parity
 
 Usage::
 
@@ -123,6 +127,24 @@ PROTOCOLS = {
         "SERVE_REQUESTS": "24", "SERVE_RATE_RPS": "0",
         "SERVE_SLOTS": "8", "SERVE_PREFILLS_PER_STEP": "8",
     },
+    # Fleet tier (docs/SERVING.md): one seeded multi-tenant closed
+    # backlog served by 1 vs 2 router-fronted replicas — the row's JSON
+    # line carries both runs, the scaling ratio and its basis
+    # (single-core hosts CANNOT scale linearly and say so instead of
+    # faking it), p99-TTFT ratio, per-tenant fairness at contention and
+    # the per-replica compile ledgers; the script exits non-zero unless
+    # scaling >= the basis floor AND p99 TTFT holds AND every tenant's
+    # token share is within 15% of its weight share AND streams are
+    # bitwise identical across runs with closed program sets.
+    "serve_lm_fleet": {
+        "_script": "scripts/fleet_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_REPLICAS": "2", "SERVE_SLOTS": "4",
+        "SERVE_TENANT_WEIGHTS": "gold:3,silver:2,bronze:1",
+        "SERVE_PLACEMENT": "affinity",
+        "SERVE_REQUESTS": "48", "SERVE_MAX_NEW": "16",
+        "SERVE_RATE_RPS": "0", "SERVE_BUCKETS": "8,16",
+    },
 }
 
 
@@ -142,6 +164,10 @@ _PROTOCOL_VARS = (
     "SERVE_KV_DTYPE", "SERVE_WEIGHT_DTYPE", "SERVE_QUANT_MATCH_MIN",
     "SERVE_SPEC_K", "SERVE_SPEC_DRAFT", "SERVE_SPEC_NGRAM_N",
     "SERVE_SPEC_MIN_SPEEDUP",
+    "SERVE_REPLICAS", "SERVE_TENANT_WEIGHTS", "SERVE_PLACEMENT",
+    "SERVE_FLEET_QUEUE_DEPTH", "SERVE_FLEET_QUANTUM",
+    "SERVE_FLEET_MIN_SCALING", "SERVE_FLEET_SINGLE_CORE_MIN",
+    "SERVE_FLEET_TTFT_MAX_RATIO", "SERVE_FLEET_FAIRNESS_TOL",
 )
 
 

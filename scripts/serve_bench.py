@@ -88,28 +88,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Request-shape mixes: (prompt_len, max_new) pairs cycled over the
-# request stream. "longtail" is the production-shaped distribution the
-# paged pool exists for — mostly short prompts, a thin tail of long
-# ones — kept to few distinct shapes so the sequential baseline's
-# per-shape warmup stays bounded.
-PROFILES = {
-    "mixed": None,  # legacy: prompt_lens cycle, SERVE_MAX_NEW everywhere
-    "longtail": (
-        [(3, 8)] * 8 + [(4, 8)] * 6 + [(6, 8)] * 5 + [(8, 8)] * 4
-        + [(12, 16)] * 3 + [(16, 16)] * 2
-        + [(24, 16), (48, 24), (96, 32)]
-    ),
-}
-MIXED_PROMPT_LENS = (4, 7, 12, 5, 16, 3, 9, 14)
-
-
-def _percentile(vals, q):
-    vals = sorted(vals)
-    if not vals:
-        return 0.0
-    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
-    return vals[idx]
+# Shape mixes + seeded Poisson load + per-shape warmup live in
+# serving/loadgen.py (shared with scripts/fleet_bench.py); the names
+# are re-exported here because this module IS the serving bench's
+# protocol surface.
+from distributeddeeplearning_tpu.serving.loadgen import (  # noqa: E402
+    MIXED_PROMPT_LENS,
+    PROFILES,
+    build_requests,
+    percentile as _percentile,
+    warm_shapes,
+)
 
 
 def _emit_record(record: dict) -> None:
@@ -123,46 +112,17 @@ def _emit_record(record: dict) -> None:
     bus.flush()
 
 
-def build_requests(n, rate_rps, seed, vocab, shapes):
-    """Seeded request set + Poisson arrival offsets (seconds) over the
-    (prompt_len, max_new) shape mix — mixed lengths, per-request
-    sampling seeds: the adversarial mix the parity oracle certifies,
-    at load."""
-    import numpy as np
-
-    rng = np.random.RandomState(seed)
-    order = rng.permutation(len(shapes))
-    reqs = []
-    t = 0.0
-    for i in range(n):
-        if rate_rps > 0:
-            t += float(rng.exponential(1.0 / rate_rps))
-        tp, max_new = shapes[order[i % len(shapes)]]
-        reqs.append({
-            "arrival_s": t,
-            "prompt": rng.randint(0, vocab, size=(tp,)).astype(np.int32),
-            "max_new": int(max_new),
-            "seed": int(rng.randint(0, 2**31 - 1)),
-        })
-    return reqs
-
-
 def run_sequential(model, params, reqs, temperature, top_k):
     """One-at-a-time baseline through inference.generate; each distinct
-    (prompt_len, max_new) shape is warmed first. Returns (tokens/sec,
-    per-request outputs, distinct compiled shapes)."""
+    (prompt_len, max_new) shape is warmed first (loadgen.warm_shapes).
+    Returns (tokens/sec, per-request outputs, distinct compiled
+    shapes)."""
     import jax
     import numpy as np
 
     from distributeddeeplearning_tpu.inference import generate
 
-    shapes = sorted({(len(r["prompt"]), r["max_new"]) for r in reqs})
-    for tp, n_new in shapes:  # warm per-shape samplers out of the timing
-        generate(
-            model, params, np.zeros((1, tp), np.int32),
-            max_new_tokens=n_new, temperature=temperature, top_k=top_k,
-            rng=jax.random.PRNGKey(0),
-        )
+    n_shapes = warm_shapes(model, params, reqs, temperature, top_k)
     outs = []
     t0 = time.perf_counter()
     for r in reqs:
@@ -174,7 +134,7 @@ def run_sequential(model, params, reqs, temperature, top_k):
         outs.append(np.asarray(out)[0])
     dt = time.perf_counter() - t0
     tokens = sum(r["max_new"] for r in reqs)
-    return tokens / dt, outs, len(shapes)
+    return tokens / dt, outs, n_shapes
 
 
 def run_continuous(server, reqs, temperature, top_k):

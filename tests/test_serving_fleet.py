@@ -1,0 +1,557 @@
+"""Fleet serving oracles (serving/fleet/ — router, replicas, streaming).
+
+The fleet tier's claims, each pinned here:
+
+1. **Weighted fairness** — deficit round robin dispatches tokens in
+   weight proportion under contention, and a weight-1 tenant still
+   progresses under a hot neighbour (no starvation).
+2. **Zero-drop drain / fault re-route** — draining a replica mid-load
+   completes or re-routes every in-flight/queued request; a *faulted*
+   replica's running requests restart elsewhere and the fleet handle
+   splices the replayed stream bitwise (per-request determinism is the
+   serving tier's contract; the splice oracle checks it survived).
+3. **Prefix-affinity placement** — a request sharing a cached prompt
+   prefix routes to the replica whose BlockAllocator holds the blocks,
+   and its prefill computes only the divergent suffix.
+4. **Streaming** — ``stream()`` / ``on_token`` deliver exactly the
+   final token sequence, incrementally, at Server, Router and
+   ``generate(engine=)`` level.
+5. **Autoscale** — the pressure gauge rises with backlog and the
+   controller's watermark hysteresis adds/drains/removes replicas.
+
+Engines are tiny (64-vocab lm) and replicas are pumped inline
+(threaded=False) wherever determinism matters.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.inference import generate
+from distributeddeeplearning_tpu.models.transformer_lm import TransformerLM
+from distributeddeeplearning_tpu.serving import (
+    ControllerConfig,
+    FleetConfig,
+    FleetController,
+    QueueFull,
+    Replica,
+    Request,
+    Router,
+    ServeConfig,
+    Server,
+    SlotEngine,
+)
+from distributeddeeplearning_tpu.serving.fleet.router import (
+    parse_tenant_weights,
+)
+
+VOCAB, MAX_LEN = 64, 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=MAX_LEN,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import flax.linen as nn
+
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, MAX_LEN), jnp.int32),
+        train=False,
+    )
+    return nn.unbox(variables["params"])
+
+
+def _scfg(**over):
+    kw = dict(num_slots=2, buckets=(8,), prefills_per_step=2)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def _pair(model, params):
+    """Two warmed inline replicas shared across the non-destructive
+    tests (engine compiles amortized module-wide)."""
+    reps = [
+        Replica(k, model, params, _scfg(), max_len=MAX_LEN).start(
+            threaded=False
+        )
+        for k in range(2)
+    ]
+    return reps
+
+
+@pytest.fixture
+def fleet(_pair):
+    """A fresh router over the shared replicas, verified idle."""
+    for r in _pair:
+        assert r.state == "ready" and r.server.active_count == 0, (
+            "previous test left the shared replicas dirty"
+        )
+    router = Router(config=FleetConfig(replicas=2, quantum=8))
+    for r in _pair:
+        r.dispatched = 0
+        router.add_replica(r, start=False)
+    return router
+
+
+def _prompt(rng, n=5):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _ref(model, params, prompt, max_new, **kw):
+    return np.asarray(
+        generate(model, params, np.asarray(prompt)[None],
+                 max_new_tokens=max_new, **kw)
+    )[0]
+
+
+# -- config / parsing ----------------------------------------------------
+
+
+def test_parse_tenant_weights():
+    assert parse_tenant_weights("a:3,b:1.5, c ,d:1") == {
+        "a": 3.0, "b": 1.5, "c": 1.0, "d": 1.0,
+    }
+
+
+def test_fleet_config_from_env_and_validation():
+    env = {
+        "SERVE_REPLICAS": "3",
+        "SERVE_TENANT_WEIGHTS": "gold:4,base:1",
+        "SERVE_PLACEMENT": "rr",
+        "SERVE_FLEET_QUEUE_DEPTH": "9",
+        "SERVE_FLEET_QUANTUM": "5",
+    }
+    cfg = FleetConfig.from_env(env)
+    assert cfg.replicas == 3
+    assert cfg.tenant_weights == {"gold": 4.0, "base": 1.0}
+    assert cfg.placement == "rr"
+    assert cfg.queue_depth == 9 and cfg.quantum == 5
+    cfg.validate()
+    with pytest.raises(ValueError):
+        FleetConfig(placement="nope").validate()
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=0).validate()
+    with pytest.raises(ValueError):
+        FleetConfig(tenant_weights={"a": 0.0}).validate()
+
+
+# -- fairness ------------------------------------------------------------
+
+
+def test_weighted_fair_dispatch_shares(fleet):
+    """Token-cost DRR: at the instant the heavy tenant's backlog
+    empties, dispatched token totals track the 3:1 weights."""
+    fleet.config.tenant_weights = {"a": 3.0, "b": 1.0}
+    fleet.set_tenant_weight("a", 3.0)
+    fleet.set_tenant_weight("b", 1.0)
+    rng = np.random.RandomState(0)
+    by_tenant = {"a": [], "b": []}
+    for i in range(12):
+        for t in ("a", "b"):
+            by_tenant[t].append(fleet.submit(Request(
+                prompt=_prompt(rng), max_new_tokens=4, temperature=0.0,
+            ), tenant=t))
+    dispatched_at_trigger = None
+    for _ in range(4000):
+        busy = fleet.step()
+        stats = fleet.tenant_stats()
+        if dispatched_at_trigger is None and stats["a"]["queued"] == 0:
+            dispatched_at_trigger = {
+                t: sum(1 for fh in hs if fh.attempts > 0)
+                for t, hs in by_tenant.items()
+            }
+        if not busy:
+            break
+    assert dispatched_at_trigger is not None
+    # a dispatched all 12; b's share of the window is 12/3 = 4 +- burst
+    assert dispatched_at_trigger["a"] == 12
+    assert 2 <= dispatched_at_trigger["b"] <= 6, dispatched_at_trigger
+    for hs in by_tenant.values():
+        for fh in hs:
+            assert fh.finish_reason == "length"
+
+
+def test_no_starvation_under_hot_neighbour(fleet):
+    """A weight-16 flood cannot starve a weight-1 tenant: the small
+    tenant banks deficit every cursor cycle and completes work while
+    the flood is still backlogged."""
+    fleet.set_tenant_weight("hot", 16.0)
+    fleet.set_tenant_weight("cold", 1.0)
+    rng = np.random.RandomState(1)
+    hot = [
+        fleet.submit(Request(
+            prompt=_prompt(rng), max_new_tokens=4, temperature=0.0,
+        ), tenant="hot")
+        for _ in range(24)
+    ]
+    cold = fleet.submit(Request(
+        prompt=_prompt(rng), max_new_tokens=4, temperature=0.0,
+    ), tenant="cold")
+    for _ in range(4000):
+        if cold.done.is_set() or not fleet.step():
+            break
+    assert cold.done.is_set() and cold.finish_reason == "length"
+    # the flood must still be mid-backlog when the small tenant finished
+    assert fleet.tenant_stats()["hot"]["queued"] > 0
+    fleet.drain(timeout=300)
+    assert all(h.finish_reason == "length" for h in hot)
+
+
+# -- parity + placement --------------------------------------------------
+
+
+def test_fleet_parity_and_least_loaded_spread(fleet, model, params):
+    """Requests served across 2 replicas are bitwise what sequential
+    generate produces, and least-loaded placement uses both pools."""
+    rng = np.random.RandomState(2)
+    cases = []
+    for i in range(8):
+        p = _prompt(rng)
+        cases.append((p, fleet.submit(Request(
+            prompt=p, max_new_tokens=6, temperature=0.8, top_k=8, rng=i,
+        ))))
+    fleet.drain(timeout=300)
+    for i, (p, fh) in enumerate(cases):
+        ref = _ref(model, params, p, 6, temperature=0.8, top_k=8,
+                   rng=jax.random.PRNGKey(i))
+        np.testing.assert_array_equal(fh.result(timeout=0), ref)
+    used = {fh.replica_id for _, fh in cases}
+    assert used == {0, 1}, f"placement collapsed onto {used}"
+
+
+def test_queue_full_backpressure(model, params, fleet):
+    fleet.config.queue_depth = 3
+    rng = np.random.RandomState(3)
+    handles = [
+        fleet.submit(Request(prompt=_prompt(rng), max_new_tokens=2))
+        for _ in range(3)
+    ]
+    with pytest.raises(QueueFull):
+        fleet.submit(Request(prompt=_prompt(rng), max_new_tokens=2))
+    fleet.drain(timeout=300)
+    assert all(h.finish_reason == "length" for h in handles)
+
+
+# -- streaming -----------------------------------------------------------
+
+
+def test_stream_iterator_matches_final_tokens(model, params):
+    """Server-level pull streaming: the iterator yields exactly the
+    final token sequence, incrementally, while another thread pumps."""
+    engine = SlotEngine(
+        model, params, num_slots=2, max_len=MAX_LEN, buckets=(8,)
+    )
+    engine.warmup()
+    server = Server(engine, prefills_per_step=2)
+    rng = np.random.RandomState(4)
+    p = _prompt(rng)
+    seen = []
+    h = server.submit(Request(
+        prompt=p, max_new_tokens=8, temperature=0.0,
+        on_token=lambda _h, toks: seen.extend(toks),
+    ))
+    stop = threading.Event()
+    pump = threading.Thread(target=server.serve_forever, args=(stop,))
+    pump.start()
+    try:
+        streamed = list(h.stream(timeout=60))
+    finally:
+        stop.set()
+        pump.join(timeout=60)
+    assert streamed == h.new_tokens == seen
+    ref = _ref(model, params, p, 8)
+    np.testing.assert_array_equal(h.tokens, ref)
+
+
+def test_generate_engine_route_streams_on_token(fleet, model, params):
+    """generate(engine=router) returns the reference tokens AND streams
+    them through on_token in row order, exactly once each."""
+    rng = np.random.RandomState(5)
+    prompts = np.stack([_prompt(rng, 6), _prompt(rng, 6)])
+    got_stream = {0: [], 1: []}
+    out = generate(
+        model, params, prompts, max_new_tokens=5,
+        engine=fleet, on_token=lambda row, tok: got_stream[row].append(tok),
+    )
+    for b in range(2):
+        np.testing.assert_array_equal(
+            out[b], np.concatenate([
+                prompts[b], np.asarray(got_stream[b], np.int32)
+            ]),
+        )
+    ref0 = _ref(model, params, prompts[0], 5)
+    np.testing.assert_array_equal(out[0], ref0)
+
+
+def test_on_token_requires_engine(model, params):
+    with pytest.raises(ValueError, match="on_token"):
+        generate(
+            model, params, np.zeros((1, 4), np.int32), max_new_tokens=2,
+            on_token=lambda row, tok: None,
+        )
+
+
+# -- drain / fault / rejoin ----------------------------------------------
+
+
+def test_drain_mid_load_completes_everything(fleet, model, params):
+    """E2E zero-drop oracle: drain a replica mid-load; every request
+    still completes with the reference stream; the drained replica
+    parks; rejoin serves again."""
+    rng = np.random.RandomState(6)
+    cases = []
+    for i in range(10):
+        p = _prompt(rng)
+        cases.append((p, fleet.submit(Request(
+            prompt=p, max_new_tokens=6, temperature=0.0,
+        ))))
+    # start streams, then drain replica 0 mid-load
+    for _ in range(2):
+        fleet.step()
+    fleet.drain_replica(0)
+    fleet.drain(timeout=300)
+    for p, fh in cases:
+        ref = _ref(model, params, p, 6)
+        np.testing.assert_array_equal(fh.result(timeout=0), ref)
+        assert fh.finish_reason == "length"
+    r0 = fleet._replica(0)
+    assert r0.state == "drained"
+    assert fleet.stats["completed"] == len(cases)
+    # rejoin (clean drain keeps the warmed engine: same program set)
+    programs_before = r0.engine.compile_count
+    fleet.rejoin_replica(0, threaded=False)
+    assert r0.state == "ready"
+    assert r0.engine.compile_count == programs_before
+    p = _prompt(rng)
+    h = fleet.submit(Request(prompt=p, max_new_tokens=3))
+    fleet.drain(timeout=300)
+    assert h.finish_reason == "length"
+
+
+def test_fault_reroutes_running_and_splices_bitwise(model, params):
+    """A replica whose pump dies mid-decode: its running requests
+    restart on the survivor and the delivered streams stay bitwise the
+    references — the splice never duplicates or diverges."""
+    reps = [
+        Replica(k, model, params, _scfg(), max_len=MAX_LEN).start(
+            threaded=False
+        )
+        for k in range(2)
+    ]
+    router = Router(config=FleetConfig(replicas=2, quantum=64))
+    for r in reps:
+        router.add_replica(r, start=False)
+    rng = np.random.RandomState(7)
+    cases = []
+    for i in range(6):
+        p = _prompt(rng)
+        cases.append((p, router.submit(Request(
+            prompt=p, max_new_tokens=10, temperature=0.0,
+        ))))
+    for _ in range(3):
+        router.step()
+    r0 = router._replica(0)
+    assert r0.server.active_count > 0, "nothing started on replica 0"
+    delivered_before = {
+        fh.id: list(fh.new_tokens) for _, fh in cases
+    }
+    r0.engine.decode_step = lambda: (_ for _ in ()).throw(
+        RuntimeError("injected engine fault")
+    )
+    router.step()  # this tick's pump faults the replica...
+    assert r0.state == "faulted"
+    assert r0.retryable  # generic crash classifies retryable (125)
+    router.step()  # ...and the next tick's health sweep re-routes
+    assert router.stats["requeued"] > 0
+    router.drain(timeout=300)
+    for i, (p, fh) in enumerate(cases):
+        ref = _ref(model, params, p, 10)
+        np.testing.assert_array_equal(fh.result(timeout=0), ref)
+        assert fh.restart_consistent, "splice diverged from determinism"
+        # tokens delivered before the fault were never re-emitted:
+        assert fh.new_tokens[: len(delivered_before[fh.id])] == (
+            delivered_before[fh.id]
+        )
+    # rejoin rebuilds the engine from scratch after a fault
+    router.rejoin_replica(0, threaded=False)
+    assert r0.state == "ready" and r0.fault is None
+    h = router.submit(Request(prompt=cases[0][0], max_new_tokens=3))
+    router.drain(timeout=300)
+    assert h.finish_reason == "length"
+
+
+# -- prefix affinity -----------------------------------------------------
+
+
+def test_prefix_affinity_routes_to_owning_replica(model, params):
+    """Paged fleet: a request sharing a cached block-aligned prefix
+    routes to the replica already holding those blocks, and its prefill
+    starts at the shared boundary (suffix-only compute)."""
+    scfg = _scfg(
+        kv_layout="paged", block_size=4, num_blocks=64,
+        prefix_cache=True, buckets=(16, 32),
+    )
+    reps = [
+        Replica(k, model, params, scfg, max_len=MAX_LEN).start(
+            threaded=False
+        )
+        for k in range(2)
+    ]
+    router = Router(config=FleetConfig(replicas=2, placement="affinity"))
+    for r in reps:
+        router.add_replica(r, start=False)
+    rng = np.random.RandomState(8)
+    shared = _prompt(rng, 12)
+    h1 = router.submit(Request(prompt=shared, max_new_tokens=3))
+    router.drain(timeout=300)
+    owner = h1.replica_id
+    assert owner is not None
+    other = 1 - owner
+    assert router._replica(owner).prefix_hit_blocks(shared) > 0
+    assert router._replica(other).prefix_hit_blocks(shared) == 0
+    # a prompt extending the shared prefix routes to the owner...
+    p2 = np.concatenate([shared, _prompt(rng, 6)])
+    h2 = router.submit(Request(prompt=p2, max_new_tokens=3))
+    router.step()
+    assert h2.replica_id == owner
+    last = router._replica(owner).engine.last_prefill
+    assert last["shared_blocks"] > 0 and last["start"] > 0
+    router.drain(timeout=300)
+    # ...and parity holds through the shared-prefix route
+    ref = _ref(model, params, p2, 3)
+    np.testing.assert_array_equal(h2.result(timeout=0), ref)
+    # an unrelated prompt is NOT affinity-bound (falls to least-loaded)
+    h3 = router.submit(Request(prompt=_prompt(rng, 6), max_new_tokens=2))
+    router.drain(timeout=300)
+    assert h3.finish_reason == "length"
+    router.close()
+
+
+# -- autoscale signal + controller ---------------------------------------
+
+
+def test_pressure_rises_with_backlog(fleet):
+    rng = np.random.RandomState(9)
+    assert fleet.pressure() == 0.0
+    handles = [
+        fleet.submit(Request(prompt=_prompt(rng), max_new_tokens=2))
+        for _ in range(12)
+    ]
+    # 12 demanded over 4 ready slots
+    assert fleet.pressure() == pytest.approx(3.0)
+    fleet.step()
+    assert fleet.last_pressure > 0
+    fleet.drain(timeout=300)
+    assert fleet.pressure() == 0.0
+    assert all(h.finish_reason == "length" for h in handles)
+
+
+def test_controller_scales_up_and_drains(model, params):
+    """Watermark hysteresis on a synthetic pressure trace: sustained
+    high pressure adds a replica (factory-built), sustained low drains
+    the least-loaded one and removes it once drained."""
+    reps = [
+        Replica(0, model, params, _scfg(), max_len=MAX_LEN).start(
+            threaded=False
+        )
+    ]
+    router = Router(config=FleetConfig(replicas=1))
+    router.add_replica(reps[0], start=False)
+    built = []
+
+    def factory(rid):
+        r = Replica(rid, model, params, _scfg(), max_len=MAX_LEN)
+        built.append(rid)
+        return r
+
+    trace = iter([2.5, 2.5, 2.5, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1])
+    ctl = FleetController(
+        router, factory,
+        ControllerConfig(min_replicas=1, max_replicas=2,
+                         high_pressure=1.0, low_pressure=0.3,
+                         up_ticks=3, down_ticks=2),
+        reader=lambda: next(trace, None),
+        threaded_replicas=False,
+    )
+    assert ctl.tick() is None
+    assert ctl.tick() is None
+    assert ctl.tick() == "scale_up"
+    assert built == [1]
+    assert len(router.replicas) == 2
+    assert ctl.tick() is None      # cold 1
+    assert ctl.tick() == "drain"   # cold 2 -> drain least-loaded
+    drained = [r for r in router.replicas if r.state in (
+        "draining", "drained"
+    )]
+    assert len(drained) == 1
+    router.step()  # inline pump parks the empty draining replica
+    assert ctl.tick() == "remove"
+    assert len(router.replicas) == 1
+    assert router.replicas[0].state == "ready"
+    router.close()
+
+
+# -- per-replica observability -------------------------------------------
+
+
+def test_per_replica_event_streams_and_watch_rows(model, params, tmp_path,
+                                                  monkeypatch):
+    """Each replica writes its own events-p0-s<k>.jsonl; the rollup
+    snapshot grows a per-proc section and obs_watch renders one row per
+    replica stream instead of collapsing the gauges."""
+    from distributeddeeplearning_tpu import obs
+    from distributeddeeplearning_tpu.obs.rollup import LivePlane
+
+    obsdir = str(tmp_path / "run")
+    monkeypatch.setenv("OBS_DIR", obsdir)
+    obs.configure(obsdir)
+    try:
+        reps = [
+            Replica(k, model, params, _scfg(), max_len=MAX_LEN,
+                    obs_dir=obsdir).start(threaded=False)
+            for k in range(2)
+        ]
+        router = Router(config=FleetConfig(replicas=2))
+        for r in reps:
+            router.add_replica(r, start=False)
+        rng = np.random.RandomState(10)
+        for _ in range(6):
+            router.submit(Request(prompt=_prompt(rng), max_new_tokens=3))
+        router.drain(timeout=300)
+        obs.flush()
+        for r in reps:
+            r.bus.flush()
+        names = sorted(os.listdir(obsdir))
+        assert "events-p0-s0.jsonl" in names
+        assert "events-p0-s1.jsonl" in names
+        plane = LivePlane(obsdir)
+        snap = plane.poll(write=False)
+        procs = snap.get("procs")
+        assert procs and {"p0-s0", "p0-s1"} <= set(procs)
+        for k in ("p0-s0", "p0-s1"):
+            assert "serve.slot_occupancy" in procs[k]
+            assert "serve.programs" in procs[k]
+        # fleet gauges land on the router's (global) stream
+        assert "serve.fleet_pressure" in snap["gauges"]
+        from scripts.obs_watch import render, replica_rows
+
+        rows = replica_rows(snap)
+        assert rows is not None and len(rows) == 2
+        text = render(snap)
+        assert "serving replicas" in text
+        assert "p0-s0" in text and "p0-s1" in text
+        router.close()
+    finally:
+        obs.reset()
