@@ -23,8 +23,8 @@ PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        obs-watch bench-trend accum-memory fault-suite serve-bench \
-        serve-bench-spec fleet-bench native \
+        obs-watch bench-trend accum-memory fault-suite elastic-drill \
+        serve-bench serve-bench-spec fleet-bench native \
         provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -119,6 +119,13 @@ fault-suite:	## fast fault-injection battery: plan grammar, supervisor e2e,
 	## (the heavy resume-equivalence oracles run with the full suite)
 	$(PY) -m pytest tests/test_faults.py tests/test_fault_tolerance.py \
 	    -x -q -m "not heavy"
+
+elastic-drill:	## fast elastic battery: shrink/restore grammar, capacity
+	## probe, checkpoint portability across 1/4/8 devices, global data
+	## topology, and the jax-light supervisor shrink->resume->grow e2e
+	## (the heavy trajectory oracles run with the full suite;
+	## docs/ROBUSTNESS.md elasticity section)
+	$(PY) -m pytest tests/test_elastic.py -x -q -m "not heavy"
 
 # Render the observability report for the most recent run directory
 # (OBS_RUN=dir overrides; runs land under runs/ by convention — the

@@ -614,6 +614,10 @@ def main():
             per_chip = ips / n_dev
             detail = {
                 "devices": n_dev,
+                # world_size mirrors devices for bench_trend's
+                # world_change protocol skip: an elastic-era resize is a
+                # new baseline, not a regression (scripts/bench_trend.py)
+                "world_size": n_dev,
                 "per_device_batch": per_device_batch,
                 "images_per_sec_per_device": round(per_chip, 1),
                 "platform": jax.devices()[0].platform,

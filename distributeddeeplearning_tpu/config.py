@@ -176,11 +176,38 @@ class TrainConfig:
     # (a deliberate host sync — durability traded against the sync-free
     # loop; the ≤1-sync/epoch contract applies at k=0).
     checkpoint_every_steps: int = 0
+    # How many checkpoints the manager retains (env CHECKPOINT_KEEP;
+    # orbax max_to_keep). The default 3 suits epoch keying; step-granular
+    # elastic runs that roll back across resizes want a deeper history.
+    checkpoint_keep: int = 3
     # env CHECKPOINT_ASYNC (default on): off makes every save durable
     # before it returns — what the deterministic fault oracles need so
     # "killed after step N" implies "checkpoint N committed".
     checkpoint_async: bool = True
     resume: bool = True  # env RESUME (the supervisor re-asserts it)
+    # Elastic worlds (env ELASTIC; docs/ROBUSTNESS.md elasticity
+    # section): this run may be a shrunken/regrown relaunch of a larger
+    # world. The loop then ENFORCES the accum-rescale math contract at
+    # resume — the checkpoint manifest's effective batch must equal
+    # batch_size_per_device × batch shards on the new topology (the
+    # supervisor holds it constant by rescaling BATCHSIZE and
+    # ACCUM_STEPS together) — instead of merely warning.
+    elastic: bool = False
+    # Peak-LR world size override (env LR_WORLD_SIZE): the linear-
+    # scaling rule normally tracks the resolved mesh's batch-shard
+    # count, which would silently change the schedule when an elastic
+    # relaunch runs on fewer devices. The supervisor pins it to the
+    # FULL world so the trajectory is preserved across resizes.
+    lr_world_size: Optional[int] = None
+    # Synthetic-data sharding topology (env DATA_TOPOLOGY):
+    #   "process" — each process draws a disjoint per-process stream
+    #     (DistributedSampler parity; the historical default), which
+    #     makes the delivered GLOBAL batch depend on the process count;
+    #   "global"  — one process-count-independent global stream, each
+    #     process slicing its contiguous share of every global batch.
+    #     Required for elastic resizes to preserve the math
+    #     (docs/DATA.md).
+    data_topology: str = "process"
     # On-device non-finite-loss guard (env NONFINITE_ACTION): the metric
     # accumulator counts NaN/Inf-loss steps on device (zero extra host
     # syncs); at the epoch boundary "abort" raises faults.
@@ -285,6 +312,8 @@ class TrainConfig:
             )
         if "MODEL" in e:
             kw["model"] = e["MODEL"]
+        if "COMPUTE_DTYPE" in e:
+            kw["compute_dtype"] = e["COMPUTE_DTYPE"]
         if "ATTN_IMPL" in e:
             kw["attn_impl"] = e["ATTN_IMPL"]
         if "MOE_EXPERTS" in e:
@@ -340,12 +369,22 @@ class TrainConfig:
         # checkpointing, save durability, resume toggle, NaN guard.
         if "CHECKPOINT_EVERY_STEPS" in e:
             kw["checkpoint_every_steps"] = int(e["CHECKPOINT_EVERY_STEPS"])
+        if "CHECKPOINT_KEEP" in e:
+            kw["checkpoint_keep"] = int(e["CHECKPOINT_KEEP"])
         if "CHECKPOINT_ASYNC" in e:
             kw["checkpoint_async"] = _str_to_bool(e["CHECKPOINT_ASYNC"])
         if "RESUME" in e:
             kw["resume"] = _str_to_bool(e["RESUME"])
         if "NONFINITE_ACTION" in e:
             kw["nonfinite_action"] = e["NONFINITE_ACTION"]
+        # Elastic-worlds contract (docs/ROBUSTNESS.md): the supervisor
+        # exports these on every resized relaunch.
+        if "ELASTIC" in e:
+            kw["elastic"] = _str_to_bool(e["ELASTIC"])
+        if "LR_WORLD_SIZE" in e:
+            kw["lr_world_size"] = int(e["LR_WORLD_SIZE"])
+        if "DATA_TOPOLOGY" in e:
+            kw["data_topology"] = e["DATA_TOPOLOGY"]
         # Smoke-test knobs (not in the reference contract): shrink the
         # problem so the identical code path runs fast on CPU.
         if "IMAGE_SIZE" in e:

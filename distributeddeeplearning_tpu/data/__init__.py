@@ -61,6 +61,7 @@ def make_dataset(config, train: bool = True):
             process_count=jax.process_count(),
             exact=not train,
             dtype=dtype,
+            topology=getattr(config, "data_topology", "process"),
         )
     root = config.data_dir if train else config.val_data_dir
     pattern = _tfrecord_pattern(root)  # one directory scan, reused below

@@ -46,6 +46,14 @@ def _virtual_translation(
     return idx_seed, translation
 
 
+def _check_topology(topology: str) -> str:
+    if topology not in ("process", "global"):
+        raise ValueError(
+            f"data topology must be 'process' or 'global', got {topology!r}"
+        )
+    return topology
+
+
 def _epoch_permutation(
     idx_seed: int, translation: np.ndarray, epoch_index: int
 ) -> np.ndarray:
@@ -80,6 +88,7 @@ class SyntheticImageDataset:
         one_hot: bool = False,
         exact: bool = False,
         dtype: np.dtype = np.float32,
+        topology: str = "process",
     ):
         _check_divisible(global_batch_size, process_count)
         self.length = length
@@ -90,9 +99,21 @@ class SyntheticImageDataset:
         self.one_hot = one_hot
         self.process_index = process_index
         self.process_count = process_count
+        # topology="global" (DATA_TOPOLOGY, docs/DATA.md): ONE
+        # process-count-independent stream — pool and translation index
+        # are seeded/sized from the GLOBAL batch and each process takes
+        # its contiguous slice of every global batch, so the delivered
+        # global batch is identical at any world size (what elastic
+        # shrink/grow needs to preserve the math). "process" keeps the
+        # reference's disjoint per-process streams.
+        self.topology = _check_topology(topology)
 
         rng = np.random.RandomState(seed)  # seed 42 parity (TF :284-287)
-        pool_n = num_physical_batches * self.local_batch_size
+        pool_batch = (
+            global_batch_size if self.topology == "global"
+            else self.local_batch_size
+        )
+        pool_n = num_physical_batches * pool_batch
         # Pool fill goes through the native threaded counter-mode fill
         # (native/ddl_native.cc; numpy fallback is bit-identical): the
         # pool is GBs at bench batch sizes and RandomState.uniform is
@@ -121,16 +142,32 @@ class SyntheticImageDataset:
         # virtual sample is served exactly once, with the trailing partial
         # batch padded and zero-weighted.
         self.exact = exact
-        if exact:
+        if self.topology == "global":
+            # One global translation index, identical on every process
+            # (seed offset 0, sized to the full virtual length); the
+            # per-process share is a slice taken per batch in epoch().
+            self.steps_per_epoch = (
+                -(-length // global_batch_size) if exact
+                else max(length // global_batch_size, 1)
+            )
+            self._idx_seed, self._translation_index = _virtual_translation(
+                seed, 0, pool_n, length
+            )
+            self._local_len = length
+        elif exact:
             local_len = (length - process_index + process_count - 1) // process_count
             self.steps_per_epoch = -(-length // global_batch_size)
+            self._idx_seed, self._translation_index = _virtual_translation(
+                seed, process_index, pool_n, local_len
+            )
+            self._local_len = local_len
         else:
             local_len = length // process_count
             self.steps_per_epoch = max(length // global_batch_size, 1)
-        self._idx_seed, self._translation_index = _virtual_translation(
-            seed, process_index, pool_n, local_len
-        )
-        self._local_len = local_len
+            self._idx_seed, self._translation_index = _virtual_translation(
+                seed, process_index, pool_n, local_len
+            )
+            self._local_len = local_len
 
     def __len__(self) -> int:
         return self.length
@@ -146,7 +183,13 @@ class SyntheticImageDataset:
         b = self.local_batch_size
         index = _epoch_permutation(self._idx_seed, self._translation_index, epoch_index)
         for step in range(self.steps_per_epoch):
-            start = step * b
+            if self.topology == "global":
+                # This process's contiguous slice of the GLOBAL batch:
+                # concatenated over processes (mesh order), every world
+                # size delivers the same global batch.
+                start = step * self.global_batch_size + self.process_index * b
+            else:
+                start = step * b
             slots = np.arange(start, start + b)
             sel = index[slots % len(index)]
             images = self._images[sel]
@@ -155,6 +198,7 @@ class SyntheticImageDataset:
                 labels = np.eye(self.num_classes, dtype=np.float32)[labels]
             if self.exact:
                 # weight 0 on padded slots past this process's share
+                # (global topology: past the global virtual length)
                 weights = (slots < self._local_len).astype(np.float32)
                 yield images, labels, weights
             else:
@@ -185,6 +229,7 @@ class SyntheticTokenDataset:
         seed: int = 42,
         process_index: int = 0,
         process_count: int = 1,
+        topology: str = "process",
     ):
         _check_divisible(global_batch_size, process_count)
         self.length = length
@@ -194,14 +239,21 @@ class SyntheticTokenDataset:
         self.vocab_size = vocab_size
         self.process_index = process_index
         self.process_count = process_count
+        self.topology = _check_topology(topology)
 
         rng = np.random.RandomState(seed)
-        pool_n = num_physical_batches * self.local_batch_size
+        if self.topology == "global":
+            # Process-count-independent stream (see the image dataset).
+            pool_n = num_physical_batches * global_batch_size
+            idx_args = (seed, 0, pool_n, length)
+        else:
+            pool_n = num_physical_batches * self.local_batch_size
+            idx_args = (seed, process_index, pool_n, length // process_count)
         self._rows = rng.randint(
             0, vocab_size, size=(pool_n, seq_len + 1)
         ).astype(np.int32)
         self._idx_seed, self._translation_index = _virtual_translation(
-            seed, process_index, pool_n, length // process_count
+            *idx_args
         )
         self.steps_per_epoch = max(length // global_batch_size, 1)
 
@@ -212,7 +264,11 @@ class SyntheticTokenDataset:
         b = self.local_batch_size
         index = _epoch_permutation(self._idx_seed, self._translation_index, epoch_index)
         for step in range(self.steps_per_epoch):
-            sel = index[np.arange(step * b, step * b + b) % len(index)]
+            if self.topology == "global":
+                start = step * self.global_batch_size + self.process_index * b
+            else:
+                start = step * b
+            sel = index[np.arange(start, start + b) % len(index)]
             rows = self._rows[sel]
             yield rows[:, :-1], rows[:, 1:]
 

@@ -29,15 +29,34 @@ Fault-plan grammar (``docs/ROBUSTNESS.md``)::
 
     FAULT_PLAN  := directive (";" directive)*
     directive   := kind ":" key "=" value ("," key "=" value)*
-    kind        := kill | term | hang | nan | exit
-    keys        := step (required, int: fires once N optimizer steps
-                   have completed — after the step's checkpoint, if due)
+    kind        := kill | term | hang | nan | exit | shrink
+                   | restore_capacity
+    keys        := step (required except restore_capacity, int: fires
+                   once N optimizer steps have completed — after the
+                   step's checkpoint, if due)
                    rank (optional int; default: every process)
-                   secs (hang only, default 3600)
+                   secs (hang: duration, default 3600;
+                   restore_capacity: wall-clock delay after the shrink)
                    code (exit only, default 1)
+                   ranks (shrink only: processes LOST, default 1)
 
     FAULT_PLAN="kill:step=3,rank=1"          # SIGKILL process 1 after step 3
     FAULT_PLAN="term:step=5;nan:step=2"      # SIGTERM all after 5; NaN batch 3
+    FAULT_PLAN="shrink:step=3,ranks=1;restore_capacity:secs=30"
+        # capacity-loss drill: the top rank SIGKILLs itself after step 3
+        # AND records "1 process gone" in the capacity file; 30s later
+        # the elastic supervisor's probe reads full capacity again
+    FAULT_PLAN="shrink:step=3;restore_capacity:step=6"
+        # step-indexed restore: the shrunken world itself announces
+        # restored capacity once step 6 completes (deterministic drills)
+
+Elasticity verbs (``launch.launch_supervised --elastic``): ``shrink``
+kills the top ``ranks`` processes like a slice preemption *and* writes
+the capacity file the supervisor probes before relaunching, so the
+world restarts at the surviving size; ``restore_capacity`` marks the
+moment full capacity returns — either ``secs`` after the shrink
+(wall-clock) or once the shrunken world completes global step ``step``
+(deterministic, fired by the injector like any other directive).
 
 ``nan`` poisons the *next* batch (the one whose dispatch makes
 ``step+1`` complete) by multiplying its float leaves with NaN — the
@@ -75,6 +94,12 @@ EXIT_TIMEOUT = 124
 EXIT_HUNG = 125
 #: Operator interrupt (Ctrl-C). Non-retryable: the human asked to stop.
 EXIT_INTERRUPTED = 130
+#: Elastic world-resize stop: the supervisor asked a (typically
+#: shrunken) world to stop at the next step boundary so it can relaunch
+#: at a different size (capacity returned). Retryable by definition and
+#: deliberately NOT counted against the restart budget — a resize is a
+#: coordinated handover, not a failure.
+EXIT_RESIZE = 95
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +124,8 @@ def classify_exit(rc: int) -> ExitClass:
         return ExitClass(rc, False, "interrupted")
     if rc == EXIT_HUNG:
         return ExitClass(rc, True, "world_hung")
+    if rc == EXIT_RESIZE:
+        return ExitClass(rc, True, "world_resize")
     if rc < 0:
         # subprocess convention: -N = died on signal N (SIGKILL
         # preemption, OOM-kill, segfault) — the canonical retryable case.
@@ -138,17 +165,20 @@ class NonFiniteLossError(SystemExit):
 # Fault plan
 # ---------------------------------------------------------------------------
 
-FAULT_KINDS = ("kill", "term", "hang", "nan", "exit")
-_INT_KEYS = ("step", "rank", "code")
+FAULT_KINDS = (
+    "kill", "term", "hang", "nan", "exit", "shrink", "restore_capacity"
+)
+_INT_KEYS = ("step", "rank", "code", "ranks")
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
     kind: str
-    step: int
+    step: int  # 0 only for restore_capacity's wall-clock (secs) form
     rank: Optional[int] = None  # None = every process
-    secs: float = 3600.0  # hang duration
+    secs: float = 3600.0  # hang duration / restore_capacity delay
     code: int = 1  # exit code for kind="exit"
+    ranks: int = 1  # processes LOST by a shrink
 
 
 def parse_fault_plan(text: str) -> List[Fault]:
@@ -175,15 +205,36 @@ def parse_fault_plan(text: str) -> List[Fault]:
                     f"fault directive {raw!r}: expected key=value, got {pair!r}"
                 )
             k, v = (s.strip() for s in pair.split("=", 1))
-            if k not in ("step", "rank", "secs", "code"):
+            if k not in ("step", "rank", "secs", "code", "ranks"):
                 raise ValueError(f"fault directive {raw!r}: unknown key {k!r}")
+            if k == "ranks" and kind != "shrink":
+                raise ValueError(
+                    f"fault directive {raw!r}: ranks= applies to shrink only"
+                )
             kw[k] = int(v) if k in _INT_KEYS else float(v)
-        if "step" not in kw:
+        if kind == "restore_capacity":
+            # Wall-clock (secs= after the shrink) or step-indexed (the
+            # shrunken world announces capacity at global step N).
+            if "secs" not in kw and "step" not in kw:
+                raise ValueError(
+                    f"fault directive {raw!r}: restore_capacity needs "
+                    f"secs= (wall clock) or step= (step-indexed)"
+                )
+            kw.setdefault("step", 0)
+            if kw["step"] < 0:
+                raise ValueError(
+                    f"fault directive {raw!r}: step must be >= 1"
+                )
+        elif "step" not in kw:
             raise ValueError(f"fault directive {raw!r}: step= is required")
-        if kw["step"] < 1:
+        elif kw["step"] < 1:
             raise ValueError(
                 f"fault directive {raw!r}: step counts COMPLETED optimizer "
                 f"steps and must be >= 1"
+            )
+        if kw.get("ranks", 1) < 1:
+            raise ValueError(
+                f"fault directive {raw!r}: ranks= must be >= 1"
             )
         faults.append(Fault(kind=kind, **kw))
     return faults
@@ -197,24 +248,69 @@ class FaultInjector:
     once a step (and its checkpoint, if due) completed. Each fault
     fires at most once per process lifetime, so a restarted world that
     resumes *past* the fault step recovers deterministically.
+
+    Elasticity verbs (``world``/``capacity_file`` default from the
+    launcher env — ``DDL_NUM_PROCESSES``, ``ELASTIC_CAPACITY_FILE`` or
+    ``$OBS_DIR/capacity.json``): ``shrink`` records the surviving
+    process count in the capacity file, then SIGKILLs this process when
+    it is one of the top ``ranks`` casualties; a step-indexed
+    ``restore_capacity`` marks full capacity restored and *continues
+    running* — the elastic supervisor's grow poller does the rest.
     """
 
-    def __init__(self, faults: List[Fault], rank: int = 0):
+    def __init__(
+        self,
+        faults: List[Fault],
+        rank: int = 0,
+        *,
+        world: int = 1,
+        full_world: Optional[int] = None,
+        capacity_file: Optional[str] = None,
+    ):
         self.rank = rank
+        self.world = max(int(world), 1)
+        # The ORIGINAL world size a restore_capacity announces (a
+        # shrunken relaunch runs with world < full_world).
+        self.full_world = max(int(full_world or self.world), self.world)
+        self.capacity_file = capacity_file
+        # Wall-clock restore directives (secs-only, step=0) never fire
+        # from the step clock — the shrink folds them into the capacity
+        # file as restore_at; step-indexed ones stay pending like any
+        # other fault.
+        self.restore_secs = next(
+            (
+                f.secs for f in faults
+                if f.kind == "restore_capacity" and f.step == 0
+            ),
+            None,
+        )
         self.pending = [
-            f for f in faults if f.rank is None or f.rank == rank
+            f for f in faults
+            if (f.rank is None or f.rank == rank)
+            and not (f.kind == "restore_capacity" and f.step == 0)
         ]
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultInjector"]:
-        """Build from ``FAULT_PLAN`` (+ ``DDL_PROCESS_ID`` for the rank);
-        None when no plan is set — callers skip the per-step check."""
+        """Build from ``FAULT_PLAN`` (+ ``DDL_PROCESS_ID`` for the rank,
+        ``DDL_NUM_PROCESSES``/``DDL_WORLD_FULL``/``ELASTIC_CAPACITY_FILE``
+        for the elasticity verbs); None when no plan is set — callers
+        skip the per-step check."""
         e = os.environ if env is None else env
         plan = e.get("FAULT_PLAN")
         if not plan:
             return None
         rank = int(e.get("DDL_PROCESS_ID", "0"))
-        inj = cls(parse_fault_plan(plan), rank=rank)
+        cap = e.get("ELASTIC_CAPACITY_FILE")
+        if not cap and e.get("OBS_DIR"):
+            cap = os.path.join(e["OBS_DIR"], "capacity.json")
+        inj = cls(
+            parse_fault_plan(plan),
+            rank=rank,
+            world=int(e.get("DDL_NUM_PROCESSES", "1")),
+            full_world=int(e.get("DDL_WORLD_FULL", "0")) or None,
+            capacity_file=cap,
+        )
         return inj if inj.pending else None
 
     def _take(self, global_step: int, kinds) -> List[Fault]:
@@ -244,16 +340,52 @@ class FaultInjector:
         return jax.tree.map(_p, batch)
 
     def due_after(self, global_step: int) -> bool:
-        """True when a process-terminating fault fires once ``global_step``
-        steps have completed (the loop drains checkpoints first, so the
-        resume point is deterministic)."""
+        """True when a process-terminating (or capacity-changing) fault
+        fires once ``global_step`` steps have completed (the loop drains
+        checkpoints first, so the resume point is deterministic)."""
         return any(
             f.step == global_step and f.kind != "nan" for f in self.pending
         )
 
     def fire_after(self, global_step: int) -> None:
         """Execute the terminal fault(s) for ``global_step``. kill/term/
-        exit do not return; hang sleeps silently (the watchdog's prey)."""
+        exit do not return; hang sleeps silently (the watchdog's prey);
+        shrink records lost capacity then SIGKILLs the casualties;
+        restore_capacity announces capacity and returns (training
+        continues until the supervisor's grow poller stops the world)."""
+        for f in self._take(global_step, ("shrink", "restore_capacity")):
+            bus = obs.get_bus()
+            bus.point(
+                "fault_fired", kind=f.kind, step=f.step, rank=self.rank,
+                ranks=f.ranks if f.kind == "shrink" else None,
+            )
+            bus.flush()
+            if f.kind == "restore_capacity":
+                if self.capacity_file:
+                    write_capacity(self.capacity_file, self.full_world)
+                continue
+            # Capacity is a CLUSTER-level notion: the drill means "the
+            # full world lost f.ranks processes", so the probe reads
+            # full_world - ranks however often the directive fires.
+            # The casualties are the top ranks of the CURRENT world.
+            if self.capacity_file:
+                restore_at = (
+                    time.time() + self.restore_secs
+                    if self.restore_secs is not None
+                    else None
+                )
+                write_capacity(
+                    self.capacity_file,
+                    max(self.full_world - f.ranks, 0),
+                    restore_at=restore_at,
+                )
+            if self.rank >= max(self.world - f.ranks, 0):
+                # This process is one of the preempted casualties:
+                # SIGKILL, like a real capacity loss (flight ring dumped
+                # first — SIGKILL is unhandleable).
+                if bus.directory:
+                    bus.dump_flight("fault_shrink")
+                os.kill(os.getpid(), signal.SIGKILL)
         for f in self._take(global_step, ("kill", "term", "hang", "exit")):
             bus = obs.get_bus()
             bus.point(
@@ -276,6 +408,53 @@ class FaultInjector:
                 time.sleep(f.secs)
             elif f.kind == "exit":
                 sys.exit(f.code)
+
+
+# ---------------------------------------------------------------------------
+# Capacity probe (elastic worlds — docs/ROBUSTNESS.md)
+# ---------------------------------------------------------------------------
+
+#: Env var naming the capacity file shared by the elastic supervisor and
+#: the fault injector's shrink/restore_capacity verbs.
+CAPACITY_FILE_ENV = "ELASTIC_CAPACITY_FILE"
+
+
+def write_capacity(
+    path: str, available: int, restore_at: Optional[float] = None
+) -> None:
+    """Atomically record cluster capacity: ``available`` schedulable
+    processes, optionally restored to full at wall-clock ``restore_at``.
+    In production the probe would ask the resource manager; the drills
+    make the same contract a file so the whole shrink→grow cycle is
+    reproducible."""
+    import json
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(
+            {"available": int(available), "restore_at": restore_at}, fh
+        )
+    os.replace(tmp, path)
+
+
+def probe_capacity(path: Optional[str], full: int) -> int:
+    """How many processes can be scheduled right now. No capacity file
+    (or an unreadable one — never block a relaunch on a torn write)
+    means full capacity; a recorded ``restore_at`` in the past means
+    capacity came back."""
+    import json
+
+    if not path:
+        return full
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError):
+        return full
+    restore_at = d.get("restore_at")
+    if restore_at is not None and time.time() >= float(restore_at):
+        return full
+    return max(min(int(d.get("available", full)), full), 0)
 
 
 # ---------------------------------------------------------------------------

@@ -182,6 +182,7 @@ def launch_local(
     hang_timeout: Optional[float] = None,
     obs_dir: Optional[str] = None,
     launcher_proc: str = "launcher",
+    stop_check=None,
     sink=None,
 ) -> int:
     """Run ``script`` in ``num_processes`` local python processes.
@@ -202,6 +203,12 @@ def launch_local(
     heartbeat, so a world that works silently — no stdout, telemetry
     flowing — is alive, and a *stale* event file is part of what "hung"
     means.
+
+    ``stop_check``: optional zero-arg callable polled by the supervision
+    loop; returning a truthy reason string tears the world down with
+    ``faults.EXIT_RESIZE`` (SIGTERM first, so checkpoints/flight rings
+    drain) — how the elastic supervisor stops a shrunken world when
+    capacity returns (``launch_supervised(elastic=True)``).
 
     ``obs_dir``: the world's observability run directory. The launcher
     writes its own lifecycle events (rendezvous, child start/exit,
@@ -315,6 +322,19 @@ def launch_local(
                 if sig != obs_sig:
                     obs_sig = sig
                     heartbeat[0] = time.monotonic()
+            if stop_check is not None:
+                reason = stop_check()
+                if reason:
+                    from distributeddeeplearning_tpu import faults
+
+                    sink.write(
+                        f"launch: world resize requested ({reason}); "
+                        "stopping the world for relaunch\n"
+                    )
+                    exit_code = faults.EXIT_RESIZE
+                    if lbus is not None:
+                        lbus.point("resize_stop", reason=reason)
+                    raise _ChildFailed()
             if (
                 hang_timeout
                 and time.monotonic() - heartbeat[0] > hang_timeout
@@ -392,6 +412,50 @@ def _flight_reasons(obs_dir: str, attempt: int) -> List[str]:
     return out
 
 
+def _elastic_world(full: int, available: int, min_world: int) -> int:
+    """The world size an elastic relaunch should use: the largest
+    divisor of the FULL world (so the BATCHSIZE/ACCUM_STEPS rescale is
+    an integer factor and the effective batch is exactly preserved) that
+    fits the available capacity, never below the operator's
+    ``min_world`` floor. When capacity sits below the floor, the floor's
+    smallest divisor-compatible world is returned anyway — the attempt
+    fails fast and the restart budget bounds the retries."""
+    divisors = [w for w in range(1, full + 1) if full % w == 0]
+    fits = [w for w in divisors if min_world <= w <= max(available, 0)]
+    if fits:
+        return max(fits)
+    floor = [w for w in divisors if w >= min_world]
+    return min(floor) if floor else full
+
+
+def _grow_checker(
+    cap_file: str, full: int, cur: int, min_world: int, every_s: float
+):
+    """stop_check for a shrunken world: polls the capacity probe every
+    ``every_s`` seconds (stat-cheap, throttled — the 10 Hz supervision
+    loop stays light) and asks for a resize stop as soon as a LARGER
+    divisor-compatible world fits the restored capacity."""
+    from distributeddeeplearning_tpu import faults
+
+    state = {"next": 0.0}
+
+    def check() -> Optional[str]:
+        now = time.monotonic()
+        if now < state["next"]:
+            return None
+        state["next"] = now + max(every_s, 0.1)
+        available = faults.probe_capacity(cap_file, full)
+        target = _elastic_world(full, available, min_world)
+        if target > cur:
+            return (
+                f"capacity restored ({available} available): "
+                f"world {cur} -> {target}"
+            )
+        return None
+
+    return check
+
+
 def launch_supervised(
     script: str,
     script_args: Sequence[str] = (),
@@ -399,6 +463,9 @@ def launch_supervised(
     max_restarts: int = 0,
     restart_backoff: float = 1.0,
     backoff_cap: float = 60.0,
+    elastic: bool = False,
+    min_world_size: int = 1,
+    grow_check_every_s: float = 30.0,
     env: Optional[Dict[str, str]] = None,
     obs_dir: Optional[str] = None,
     sink=None,
@@ -429,11 +496,50 @@ def launch_supervised(
     timeout 124, operator interrupt 130) return immediately. The return
     value is shell-normalized (signal deaths become 128+N). ``--timeout``
     and ``--hang-timeout`` apply per attempt.
+
+    **Elastic worlds** (``elastic=True`` / env ``ELASTIC``,
+    docs/ROBUSTNESS.md): instead of always relaunching at the full
+    size, a retryable death triggers a capacity probe
+    (``faults.probe_capacity`` over ``$ELASTIC_CAPACITY_FILE`` /
+    ``<obs_dir>/capacity.json``) and the world relaunches at the largest
+    divisor-compatible surviving size ≥ ``min_world_size`` — with the
+    MATH preserved: ``BATCHSIZE`` and ``ACCUM_STEPS`` are rescaled by
+    the same integer factor (effective batch held constant; per-device
+    microbatch, and so memory, unchanged) and ``LR_WORLD_SIZE`` is
+    pinned to the full world so the LR schedule never moves. The
+    children re-shard from the topology-independent step checkpoint
+    (``training/checkpoint.py``) and resume mid-epoch. While shrunken,
+    the supervisor polls the probe every ``grow_check_every_s`` seconds
+    and, when capacity returns, stops the world at a step boundary
+    (``faults.EXIT_RESIZE`` — a coordinated handover that burns NO
+    restart budget) and relaunches at full size, re-sharding again.
+    Attempt records (``attempt_start``) carry the world size, and
+    resizes emit ``elastic.world_resized`` points.
     """
     from distributeddeeplearning_tpu import faults
 
     sink = sink or sys.stdout
     base_env = dict(env or {})
+    full_world = int(launch_kw.pop("num_processes", 2) or 2)
+    devices_pp = int(launch_kw.get("devices_per_process") or 1)
+    cur_world = full_world
+    cap_file = None
+    base_batch = base_accum = 0
+    if elastic:
+        cap_file = base_env.get(faults.CAPACITY_FILE_ENV) or os.environ.get(
+            faults.CAPACITY_FILE_ENV
+        )
+        if not cap_file and obs_dir:
+            cap_file = os.path.join(os.path.abspath(obs_dir), "capacity.json")
+        base_batch = int(
+            base_env.get("BATCHSIZE") or os.environ.get("BATCHSIZE") or 64
+        )
+        base_accum = int(
+            base_env.get("ACCUM_STEPS")
+            or os.environ.get("ACCUM_STEPS")
+            or 1
+        )
+        min_world_size = max(int(min_world_size), 1)
     sbus = None
     if obs_dir:
         from distributeddeeplearning_tpu.obs import EventBus
@@ -457,6 +563,7 @@ def launch_supervised(
         "COMPILATION_CACHE_DIR"
     )
     attempt = 0
+    restarts_used = 0  # resizes are free; only FAILURES burn the budget
     try:
         while True:
             extra = dict(base_env)
@@ -477,17 +584,52 @@ def launch_supervised(
                             "cache_dir_suffixed", attempt=attempt,
                             dir=suffixed,
                         )
+            stop_check = None
+            if elastic:
+                # The elasticity contract the children see: capacity
+                # file for the shrink/restore drills, the FULL world for
+                # restore announcements, a pinned LR world so the
+                # schedule never moves, and — on a shrunken world — the
+                # integer BATCHSIZE/ACCUM_STEPS rescale that holds the
+                # effective batch (and per-device microbatch memory)
+                # exactly constant.
+                extra["ELASTIC"] = "1"
+                extra["DDL_WORLD_FULL"] = str(full_world)
+                extra["LR_WORLD_SIZE"] = str(full_world * devices_pp)
+                if cap_file:
+                    extra[faults.CAPACITY_FILE_ENV] = cap_file
+                scale = full_world // cur_world
+                if scale > 1:
+                    extra["BATCHSIZE"] = str(base_batch * scale)
+                    extra["ACCUM_STEPS"] = str(base_accum * scale)
+                    sink.write(
+                        f"supervisor: elastic world {cur_world}/"
+                        f"{full_world} processes — BATCHSIZE "
+                        f"{base_batch}->{base_batch * scale}, ACCUM_STEPS "
+                        f"{base_accum}->{base_accum * scale} (effective "
+                        "batch held constant)\n"
+                    )
+                if cur_world < full_world and cap_file:
+                    stop_check = _grow_checker(
+                        cap_file, full_world, cur_world, min_world_size,
+                        grow_check_every_s,
+                    )
             if sbus is not None:
-                sbus.point("attempt_start", attempt=attempt)
+                sbus.point(
+                    "attempt_start", attempt=attempt, world_size=cur_world,
+                    full_world=full_world if elastic else None,
+                )
                 sbus.flush()
             rc = launch_local(
                 script,
                 script_args,
+                num_processes=cur_world,
                 env=extra,
                 obs_dir=obs_dir,
                 launcher_proc=(
                     "launcher" if attempt == 0 else f"launcher-r{attempt}"
                 ),
+                stop_check=stop_check,
                 sink=sink,
                 **launch_kw,
             )
@@ -498,6 +640,7 @@ def launch_supervised(
                     "attempt_exit",
                     attempt=attempt,
                     rc=rc,
+                    world_size=cur_world,
                     retryable=verdict.retryable,
                     reason=verdict.reason,
                     flight=", ".join(flight) or None,
@@ -505,26 +648,76 @@ def launch_supervised(
                 sbus.flush()
             if rc == 0:
                 return 0
+            if elastic and rc == faults.EXIT_RESIZE:
+                # Coordinated grow-back handover: capacity returned, the
+                # world was stopped at a step boundary — relaunch at the
+                # restored size with resume; no backoff, no budget.
+                available = faults.probe_capacity(cap_file, full_world)
+                new_world = _elastic_world(
+                    full_world, available, min_world_size
+                )
+                sink.write(
+                    f"supervisor: world resize {cur_world} -> {new_world} "
+                    f"({available} available); relaunching with resume "
+                    "(no restart budget consumed)\n"
+                )
+                if sbus is not None:
+                    sbus.point(
+                        "elastic.world_resized",
+                        from_world=cur_world,
+                        to_world=new_world,
+                        phase="grow",
+                        attempt=attempt + 1,
+                    )
+                    sbus.flush()
+                cur_world = new_world
+                attempt += 1
+                continue
             if not verdict.retryable:
                 sink.write(
                     f"supervisor: rc={rc} ({verdict.reason}) is "
                     "non-retryable; giving up\n"
                 )
                 return faults.normalize_rc(rc)
-            if attempt >= max_restarts:
+            if restarts_used >= max_restarts:
                 sink.write(
                     f"supervisor: restart budget exhausted "
                     f"({max_restarts}); last failure rc={rc} "
                     f"({verdict.reason})\n"
                 )
                 return faults.normalize_rc(rc)
-            delay = min(restart_backoff * (2 ** attempt), backoff_cap)
+            next_world = cur_world
+            if elastic:
+                available = faults.probe_capacity(cap_file, full_world)
+                next_world = _elastic_world(
+                    full_world, available, min_world_size
+                )
+                if next_world != cur_world:
+                    sink.write(
+                        f"supervisor: capacity probe says {available} of "
+                        f"{full_world} processes available — shrinking "
+                        f"world {cur_world} -> {next_world} for the "
+                        "relaunch (math preserved via the ACCUM_STEPS "
+                        "rescale)\n"
+                    )
+                    if sbus is not None:
+                        sbus.point(
+                            "elastic.world_resized",
+                            from_world=cur_world,
+                            to_world=next_world,
+                            phase=(
+                                "shrink" if next_world < cur_world
+                                else "grow"
+                            ),
+                            attempt=attempt + 1,
+                        )
+            delay = min(restart_backoff * (2 ** restarts_used), backoff_cap)
             sink.write(
                 f"supervisor: attempt {attempt} failed (rc={rc}, "
                 f"{verdict.reason}"
                 + (f"; flight: {', '.join(flight)}" if flight else "")
                 + f"); restarting in {delay:g}s with resume enabled "
-                f"(restart {attempt + 1}/{max_restarts})\n"
+                f"(restart {restarts_used + 1}/{max_restarts})\n"
             )
             if sbus is not None:
                 sbus.counter("restarts")
@@ -534,10 +727,13 @@ def launch_supervised(
                     backoff_s=delay,
                     rc=rc,
                     reason=verdict.reason,
+                    world_size=next_world,
                 )
                 sbus.flush()
             time.sleep(delay)
+            cur_world = next_world
             attempt += 1
+            restarts_used += 1
     finally:
         if sbus is not None:
             sbus.point("supervisor_exit")
@@ -759,6 +955,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="base seconds between restarts (exponential: base * 2^attempt,"
         " capped at 60s; default: $RESTART_BACKOFF or 1.0)",
     )
+    ap.add_argument(
+        "--elastic",
+        action="store_true",
+        default=os.environ.get("ELASTIC", "").strip().lower()
+        in ("1", "true", "t", "yes", "y", "on"),
+        help="elastic worlds: on a retryable death, probe capacity and "
+        "relaunch at the surviving world size with BATCHSIZE/ACCUM_STEPS "
+        "rescaled (effective batch held constant), then grow back to "
+        "full size when capacity returns (default: $ELASTIC; requires "
+        "--max-restarts; docs/ROBUSTNESS.md)",
+    )
+    ap.add_argument(
+        "--min-world-size",
+        type=int,
+        default=int(os.environ.get("MIN_WORLD_SIZE", "1")),
+        help="elastic floor: never relaunch below this many processes "
+        "(default: $MIN_WORLD_SIZE or 1)",
+    )
+    ap.add_argument(
+        "--grow-check-every-s",
+        type=float,
+        default=float(os.environ.get("GROW_CHECK_EVERY_S", "30")),
+        help="how often a shrunken elastic world polls the capacity "
+        "probe for grow-back (default: $GROW_CHECK_EVERY_S or 30)",
+    )
     ap.add_argument("--no-tag-output", action="store_true")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -781,6 +1002,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ap.error(
                 "--max-restarts applies to local mode only, not --tpu "
                 "(pod jobs are resubmitted through orchestration/submit)"
+            )
+        if args.elastic:
+            ap.error(
+                "--elastic applies to local mode only, not --tpu "
+                "(pod resizes go through orchestration/provision)"
             )
         if args.obs_dir:
             # Pod mode: no shared filesystem to merge on — each worker
@@ -811,12 +1037,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         timeout=args.timeout,
         hang_timeout=args.hang_timeout,
     )
+    if args.elastic and args.max_restarts <= 0:
+        ap.error("--elastic requires --max-restarts >= 1 (the supervisor)")
     if args.max_restarts > 0:
         return launch_supervised(
             args.script,
             args.script_args,
             max_restarts=args.max_restarts,
             restart_backoff=args.restart_backoff,
+            elastic=args.elastic,
+            min_world_size=args.min_world_size,
+            grow_check_every_s=args.grow_check_every_s,
             env=extra_env,
             obs_dir=args.obs_dir,
             **local_kw,

@@ -133,6 +133,7 @@ class ModelCheckpointCallback(Callback):
             # mid-epoch saves and resume go through this same manager).
             self._mgr = CheckpointManager(
                 directory,
+                max_to_keep=getattr(cfg, "checkpoint_keep", 3) if cfg else 3,
                 save_every_epochs=self.save_every_epochs,
                 save_every_steps=getattr(cfg, "checkpoint_every_steps", 0)
                 if cfg else 0,
@@ -149,7 +150,8 @@ class ModelCheckpointCallback(Callback):
             # shared manager is step-granular (CHECKPOINT_EVERY_STEPS);
             # plain epoch keying otherwise.
             self.manager().save_epoch_end(
-                epoch, state, global_step=logs.get("global_step")
+                epoch, state, global_step=logs.get("global_step"),
+                manifest=logs.get("ckpt_manifest"),
             )
 
     def on_train_end(self, logs=None):

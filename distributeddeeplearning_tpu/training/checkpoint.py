@@ -38,20 +38,143 @@ Robustness layer (ISSUE 4):
   durable before ``save*`` returns — what the deterministic
   fault-injection oracles use so "killed after step N" implies
   "checkpoint N is committed".
+
+Elastic layer (ISSUE 11 — topology-independent checkpoints):
+
+* **Canonical logical layout** — every save is an orbax composite of
+  the *global-array* state plus a JSON **manifest** recording the run
+  position and geometry (``global_step``, ``epoch``/``step_in_epoch``
+  data cursor, ``steps_per_epoch``, ``effective_batch``,
+  ``accum_steps``, ``world_size``/``process_count``). The state item is
+  written per-leaf as global arrays (orbax/tensorstore's OCDBT layout is
+  already device-layout-free), so ``restore`` can place shards onto
+  **any** mesh shape or device count: the abstract target's shardings —
+  not the topology that wrote the checkpoint — decide placement.
+* **Resume decode from the manifest** — ``maybe_restore_at`` reads the
+  data cursor from the manifest instead of arithmetically decoding the
+  step key, so resume stays correct even when the restoring world's
+  geometry differs (the legacy ``key // steps_per_epoch`` decode remains
+  the manifest-less fallback).
+* **``reshard_state``** — places an existing (live or restored) state
+  onto a new topology by host-materialising each leaf once and
+  re-assembling with ``jax.make_array_from_callback`` (no cross-process
+  traffic — every process uploads exactly its addressable shards).
+  Restore across topologies reports its cost as the
+  ``elastic.reshard_ms`` gauge + an ``elastic.world_resized`` point —
+  boundary-time work, never on the per-step path.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Tuple
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from distributeddeeplearning_tpu import obs
 from distributeddeeplearning_tpu.utils.logging import get_logger
 
 PyTree = Any
+
+#: Manifest schema version (bump on incompatible field changes).
+MANIFEST_FORMAT = 1
+
+
+def build_manifest(
+    *,
+    global_step: int,
+    steps_per_epoch: int,
+    effective_batch: int,
+    accum_steps: int = 1,
+    world_size: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The topology-independence contract, as data: where the run is
+    (``epoch``/``step_in_epoch`` data cursor) and what geometry produced
+    it (``effective_batch``/``accum_steps``/``world_size``), so a
+    restore onto a different device count can (a) resume the stream at
+    the right batch and (b) validate that the *math* is preserved —
+    effective batch held constant via the ACCUM_STEPS rescale
+    (docs/ROBUSTNESS.md elasticity section)."""
+    spe = max(int(steps_per_epoch), 1)
+    return {
+        "format": MANIFEST_FORMAT,
+        "global_step": int(global_step),
+        "epoch": int(global_step) // spe,
+        "step_in_epoch": int(global_step) % spe,
+        "steps_per_epoch": spe,
+        "effective_batch": int(effective_batch),
+        "accum_steps": int(accum_steps),
+        "world_size": (
+            int(world_size) if world_size is not None else jax.device_count()
+        ),
+        "process_count": (
+            int(process_count)
+            if process_count is not None
+            else jax.process_count()
+        ),
+    }
+
+
+def reshard_state(state: PyTree, like: PyTree) -> PyTree:
+    """Place ``state``'s values onto ``like``'s topology (shardings).
+
+    ``like`` is a template pytree of arrays or ``ShapeDtypeStruct``s
+    carrying the TARGET shardings (e.g. a freshly-initialised state on
+    the new mesh — which is also how the optimizer state's *structure*
+    is rebuilt on the new topology; this function then overwrites its
+    values). Each leaf is host-materialised once and re-assembled with
+    ``jax.make_array_from_callback``: every process uploads only its
+    addressable shards, so there is no cross-process traffic (the same
+    reason ``train_step.replicate_state`` avoids the naive
+    ``device_put``-onto-non-addressable-sharding broadcast). Boundary
+    work — call it at restore/resize time, never per step."""
+
+    def _place(x, tmpl):
+        sharding = getattr(tmpl, "sharding", None)
+        if sharding is None or not hasattr(x, "shape"):
+            return x
+        if not hasattr(x, "addressable_data"):
+            host = np.asarray(x)
+        elif getattr(x, "is_fully_addressable", True):
+            host = np.asarray(x)
+        elif getattr(x, "is_fully_replicated", False):
+            host = np.asarray(x.addressable_data(0))
+        else:
+            raise ValueError(
+                "reshard_state: a partially-sharded leaf of a "
+                "multi-process array cannot be re-assembled in memory "
+                "without cross-host traffic — reshard through a "
+                "checkpoint save/restore instead"
+            )
+        if host.shape != tuple(tmpl.shape):
+            raise ValueError(
+                f"reshard_state: leaf shape {host.shape} != template "
+                f"shape {tuple(tmpl.shape)} — global shapes are "
+                f"topology-independent and must match"
+            )
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx]
+        )
+
+    return jax.tree.map(_place, state, like)
+
+
+def _state_world(state: PyTree) -> int:
+    """Device count of the topology ``state`` lives on (the union of
+    every leaf's sharding devices) — 0 when no leaf carries a sharding.
+    A sub-mesh world can be smaller than ``jax.device_count()``, so the
+    cross-topology telemetry measures the state, not the process."""
+    devs: set = set()
+    for leaf in jax.tree.leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        device_set = getattr(sharding, "device_set", None)
+        if device_set:
+            devs |= set(device_set)
+    return len(devs)
 
 
 class CheckpointManager:
@@ -78,6 +201,10 @@ class CheckpointManager:
         # Set by the loop at resume time; needed to decode step-granular
         # keys back into (epoch, step_in_epoch).
         self._steps_per_epoch: Optional[int] = None
+        # Manifest of the most recent successful restore (None when the
+        # checkpoint predates the manifest layout) — the loop reads it
+        # for the elastic effective-batch validation.
+        self.last_manifest: Optional[Dict[str, Any]] = None
         if directory is None:
             self._mgr = None
             return
@@ -101,7 +228,27 @@ class CheckpointManager:
         step (``CHECKPOINT_EVERY_STEPS > 0``) rather than by epoch."""
         return self._every_steps > 0
 
-    def save(self, epoch: int, state: PyTree, force: bool = False) -> bool:
+    def _save_args(self, state: PyTree, manifest):
+        """Every save is the composite canonical layout: the global-array
+        ``state`` item plus the JSON ``manifest`` item (possibly empty —
+        a uniform on-disk shape keeps restore simple). ``manifest`` may
+        be a dict or a zero-arg callable returning one — callers on the
+        per-step path pass the callable so the dict is only built for
+        saves that are actually due."""
+        if callable(manifest):
+            manifest = manifest()
+        return ocp.args.Composite(
+            state=ocp.args.StandardSave(state),
+            manifest=ocp.args.JsonSave(dict(manifest or {})),
+        )
+
+    def save(
+        self,
+        epoch: int,
+        state: PyTree,
+        force: bool = False,
+        manifest=None,
+    ) -> bool:
         """Save at end of ``epoch`` (0-based) if due; returns True if saved.
 
         Epoch-keyed — callers on the step-granular contract use
@@ -113,13 +260,17 @@ class CheckpointManager:
         if not force and (epoch + 1) % self._save_every != 0:
             return False
         with obs.span("checkpoint_save", epoch=epoch):
-            saved = self._mgr.save(epoch, args=ocp.args.StandardSave(state))
+            saved = self._mgr.save(epoch, args=self._save_args(state, manifest))
         if saved:
             self._log.info("checkpoint saved", extra={"epoch": epoch})
         return bool(saved)
 
     def save_step(
-        self, global_step: int, state: PyTree, force: bool = False
+        self,
+        global_step: int,
+        state: PyTree,
+        force: bool = False,
+        manifest=None,
     ) -> bool:
         """Step-granular save: key = completed optimizer steps. Due every
         ``save_every_steps``; ``force`` saves regardless (the epoch
@@ -135,14 +286,18 @@ class CheckpointManager:
             return False  # already saved (epoch boundary == due step)
         with obs.span("checkpoint_save", step=global_step):
             saved = self._mgr.save(
-                global_step, args=ocp.args.StandardSave(state)
+                global_step, args=self._save_args(state, manifest)
             )
         if saved:
             self._log.info("checkpoint saved", extra={"step": global_step})
         return bool(saved)
 
     def save_epoch_end(
-        self, epoch: int, state: PyTree, global_step: Optional[int] = None
+        self,
+        epoch: int,
+        state: PyTree,
+        global_step: Optional[int] = None,
+        manifest=None,
     ) -> bool:
         """The loop's (and checkpoint callback's) one epoch-boundary call,
         valid under either keying: epoch mode defers to :meth:`save`;
@@ -151,8 +306,10 @@ class CheckpointManager:
         if self.step_granular and global_step is not None:
             if (epoch + 1) % self._save_every != 0:
                 return False
-            return self.save_step(global_step, state, force=True)
-        return self.save(epoch, state)
+            return self.save_step(
+                global_step, state, force=True, manifest=manifest
+            )
+        return self.save(epoch, state, manifest=manifest)
 
     def latest_epoch(self) -> Optional[int]:
         """The resume epoch — every process reads the same answer from the
@@ -165,16 +322,58 @@ class CheckpointManager:
     def restore(self, state: PyTree, epoch: Optional[int] = None) -> PyTree:
         """Restore into the structure/shardings of ``state`` (pass the
         freshly-initialised, mesh-placed state; restored arrays land with
-        the same shardings)."""
+        the same shardings).
+
+        Topology-independent: ``state`` may live on ANY mesh shape or
+        device count — the checkpoint's global arrays are placed onto
+        ``state``'s shardings, and the checkpoint's manifest (available
+        afterwards as :attr:`last_manifest`) records the geometry that
+        wrote it. A cross-topology restore reports ``elastic.reshard_ms``
+        + an ``elastic.world_resized`` point (boundary-time cost, never
+        per-step)."""
         if self._mgr is None:
             raise RuntimeError("checkpointing disabled (no directory)")
         step = epoch if epoch is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+        self.last_manifest = None
+        t0 = time.monotonic()
         with obs.span("checkpoint_restore", epoch=step):
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
+            try:
+                out = self._mgr.restore(
+                    step,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        manifest=ocp.args.JsonRestore(),
+                    ),
+                )
+                restored = out.state
+                manifest = dict(out.manifest or {})
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except FileNotFoundError:
+                # Pre-manifest layout (single bare state item): restore
+                # it the legacy way; manifest stays None.
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract)
+                )
+                manifest = None
+        self.last_manifest = manifest or None
+        saved_world = (manifest or {}).get("world_size")
+        target_world = _state_world(state) or jax.device_count()
+        if saved_world is not None and saved_world != target_world:
+            # The reshard happened inside the restore above (shards were
+            # placed onto a different topology than wrote them): report
+            # its cost where capacity planning can see it.
+            obs.point(
+                "elastic.world_resized",
+                step=step,
+                from_world=saved_world,
+                to_world=target_world,
+            )
+            obs.gauge(
+                "elastic.reshard_ms", (time.monotonic() - t0) * 1000.0
             )
         self._log.info("checkpoint restored", extra={"epoch": step})
         return restored
@@ -230,6 +429,13 @@ class CheckpointManager:
         restored, key = self._restore_latest_valid(state)
         if key is None:
             return state, 0, 0
+        m = self.last_manifest
+        if m and "epoch" in m and "step_in_epoch" in m:
+            # Manifest-first decode: the data cursor was recorded at save
+            # time, so resume stays correct on ANY restoring topology
+            # (the arithmetic fallback below assumes the key was written
+            # against the same steps_per_epoch the caller passes).
+            return restored, int(m["epoch"]), int(m["step_in_epoch"])
         if not self.step_granular:
             return restored, key + 1, 0
         spe = self._steps_per_epoch

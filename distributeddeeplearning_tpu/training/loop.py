@@ -29,7 +29,10 @@ from distributeddeeplearning_tpu.training.callbacks import (
     CallbackList,
     LoggerCallback,
 )
-from distributeddeeplearning_tpu.training.checkpoint import CheckpointManager
+from distributeddeeplearning_tpu.training.checkpoint import (
+    CheckpointManager,
+    build_manifest,
+)
 from distributeddeeplearning_tpu.training.metrics import (
     finalize_accumulator,
     init_accumulator,
@@ -106,10 +109,22 @@ def resolve_engine(config, mesh=None):
             f"NONFINITE_ACTION={config.nonfinite_action!r} "
             "(have abort, warn, off)"
         )
+    if config.data_topology not in ("process", "global"):
+        raise ValueError(
+            f"DATA_TOPOLOGY={config.data_topology!r} (have process, global)"
+        )
+    if config.lr_world_size is not None and config.lr_world_size < 1:
+        raise ValueError(
+            f"LR_WORLD_SIZE must be >= 1, got {config.lr_world_size}"
+        )
     if config.checkpoint_every_steps < 0:
         raise ValueError(
             f"CHECKPOINT_EVERY_STEPS must be >= 0, got "
             f"{config.checkpoint_every_steps}"
+        )
+    if config.checkpoint_keep < 1:
+        raise ValueError(
+            f"CHECKPOINT_KEEP must be >= 1, got {config.checkpoint_keep}"
         )
     if mesh is None:
         # Engine-appropriate default topology when the user named an
@@ -208,7 +223,14 @@ def fit(
 
     n_batch_shards = dp_size(mesh)
     if tx is None:
-        tx, _ = create_optimizer(config, steps_per_epoch, world_size=n_batch_shards)
+        # Elastic worlds pin LR_WORLD_SIZE to the FULL world so the LR
+        # schedule (linear-scaling rule) is identical on any resized
+        # relaunch; otherwise the resolved mesh's shard count applies.
+        tx, _ = create_optimizer(
+            config,
+            steps_per_epoch,
+            world_size=config.lr_world_size or n_batch_shards,
+        )
     from distributeddeeplearning_tpu.training.engines import build_engine
 
     shape, dtype = _init_spec(train_data)
@@ -249,6 +271,7 @@ def fit(
     if ckpt is None and config.model_dir:
         ckpt = CheckpointManager(
             config.model_dir,
+            max_to_keep=config.checkpoint_keep,
             save_every_epochs=config.checkpoint_every_epochs,
             save_every_steps=config.checkpoint_every_steps,
             async_save=config.checkpoint_async,
@@ -268,6 +291,42 @@ def fit(
         state, ckpt_epoch, ckpt_skip = ckpt.maybe_restore_at(
             state, steps_per_epoch
         )
+        # Accum-rescale math contract (docs/ROBUSTNESS.md elasticity):
+        # the manifest records the effective batch the trajectory was
+        # trained at; a resumed world — on ANY topology — must deliver
+        # the same one (batch_size_per_device × batch shards; the
+        # elastic supervisor holds it constant by rescaling BATCHSIZE
+        # and ACCUM_STEPS together). ELASTIC=1 enforces; otherwise an
+        # intentional batch change only warns.
+        manifest = getattr(ckpt, "last_manifest", None)
+        if manifest and manifest.get("effective_batch"):
+            saved_eff = int(manifest["effective_batch"])
+            have_eff = config.batch_size_per_device * n_batch_shards
+            if saved_eff != have_eff:
+                msg = (
+                    f"checkpoint was trained at effective batch "
+                    f"{saved_eff} (world {manifest.get('world_size')}, "
+                    f"accum {manifest.get('accum_steps')}) but this "
+                    f"topology delivers {have_eff} "
+                    f"({config.batch_size_per_device}/device x "
+                    f"{n_batch_shards} shards) — rescale BATCHSIZE and "
+                    f"ACCUM_STEPS together to hold the effective batch "
+                    f"constant"
+                )
+                if config.elastic:
+                    raise ValueError(f"ELASTIC resume refused: {msg}")
+                log.warning("%s (continuing: ELASTIC is off)", msg)
+            elif (
+                manifest.get("steps_per_epoch")
+                and int(manifest["steps_per_epoch"]) != steps_per_epoch
+                and config.elastic
+            ):
+                raise ValueError(
+                    f"ELASTIC resume refused: checkpoint epoch geometry "
+                    f"is {manifest['steps_per_epoch']} steps/epoch, this "
+                    f"dataset delivers {steps_per_epoch} — the data "
+                    f"cursor would be meaningless"
+                )
         if (ckpt_epoch, ckpt_skip) > (start_epoch, 0):
             start_epoch, skip_steps = ckpt_epoch, ckpt_skip
         if start_epoch or skip_steps:
@@ -280,6 +339,30 @@ def fit(
     # steps_per_epoch (every repo dataset does).
     global_step = start_epoch * steps_per_epoch + skip_steps
     injector = faults.FaultInjector.from_env()
+
+    def make_manifest(step_key: int):
+        """Topology-independence record for a checkpoint at ``step_key``
+        (training/checkpoint.build_manifest). Returned as a zero-arg
+        callable so the manager only builds it for saves that are DUE —
+        the per-step path stays dict-free (and, like everything here,
+        host-int-only: zero device syncs)."""
+
+        def _build():
+            return build_manifest(
+                global_step=step_key,
+                steps_per_epoch=steps_per_epoch,
+                effective_batch=int(global_batch),
+                accum_steps=int(
+                    getattr(train_step, "accum_steps", config.accum_steps)
+                ),
+                # The RESOLVED mesh's device count (not the process-wide
+                # jax.device_count()): a sub-mesh world is smaller than
+                # the host's device pool, and world_size is what the
+                # cross-topology restore telemetry compares against.
+                world_size=int(mesh.devices.size),
+            )
+
+        return _build
 
     train_step = eng.train_step
     eval_step = eng.eval_step if eval_data is not None else None
@@ -329,9 +412,29 @@ def fit(
             # Mid-epoch resume: the dataset's epoch stream is
             # deterministic in (seed, epoch), so dropping the first k
             # batches — before any staging — replays exactly the part of
-            # the epoch the checkpoint had not yet covered.
-            batches = itertools.islice(batches, skip_steps, None)
+            # the epoch the checkpoint had not yet covered. The skip is
+            # consumed EAGERLY and timed: replaying an epoch prefix is
+            # O(step-in-epoch) host work, and the data.resume_skip
+            # span/gauges make that cost visible instead of smearing it
+            # into the first step (the hook for a checkpointable stream
+            # that seeks in O(1) — docs/DATA.md, ROADMAP item 5).
+            skip_t0 = time.monotonic()
+            batches = iter(batches)
+            skipped = sum(
+                1 for _ in itertools.islice(batches, skip_steps)
+            )
+            skip_s = time.monotonic() - skip_t0
+            bus.span_event(
+                "data.resume_skip", skip_s, epoch=epoch, skipped=skipped
+            )
+            bus.gauge("data.resume_skip_batches", float(skipped))
+            bus.gauge("data.resume_skip_ms", skip_s * 1000.0)
             bus.point("resume_skip", epoch=epoch, skipped=skip_steps)
+            log.info(
+                "resume replayed %d skipped batch(es) in %.1f ms "
+                "(O(step) epoch-prefix replay; docs/DATA.md)",
+                skipped, skip_s * 1000.0,
+            )
         for batch in prefetch_to_device(
             batches, mesh, size=config.prefetch_batches,
             sharding=eng.batch_sharding,
@@ -376,8 +479,14 @@ def fit(
                 # durability-vs-sync trade; off (the default) the loop
                 # keeps its ≤1-sync/epoch contract. Runs for callback-
                 # owned managers too (the callback only covers the epoch
-                # boundary; save_step is idempotent per key).
-                ckpt.save_step(global_step, state)
+                # boundary; save_step is idempotent per key). The
+                # manifest (host ints only — no device work) makes the
+                # checkpoint topology-independent: any world size can
+                # decode the data cursor and validate the effective
+                # batch.
+                ckpt.save_step(
+                    global_step, state, manifest=make_manifest(global_step)
+                )
             if injector is not None and injector.due_after(global_step):
                 # Make pending saves durable first so the kill point is
                 # deterministic relative to the resume point, then die.
@@ -464,11 +573,17 @@ def fit(
             if isinstance(v, (int, float)):
                 bus.gauge(f"epoch.{k}", float(v), epoch=epoch)
         epoch_logs["state"] = state
+        # Callback-owned checkpoint managers save through on_epoch_end:
+        # hand them the same lazy manifest the engine-owned path uses.
+        epoch_logs["ckpt_manifest"] = make_manifest(global_step)
         callback_list.on_epoch_end(epoch, epoch_logs)
         if engine_saves:
             # One call for either keying: epoch-keyed saves as ever, or
             # the boundary's global-step key under CHECKPOINT_EVERY_STEPS.
-            ckpt.save_epoch_end(epoch, state, global_step=global_step)
+            ckpt.save_epoch_end(
+                epoch, state, global_step=global_step,
+                manifest=make_manifest(global_step),
+            )
         bus.span_event(
             "epoch",
             time.monotonic() - epoch_t0,

@@ -123,6 +123,16 @@ def analyze(
             # same way (aggregate throughput over N pools is a new
             # baseline); non-fleet records normalize to 1 replica.
             "replicas": int(detail.get("replicas") or 1),
+            # An elastic world resize is the training-side analog: the
+            # same metric over a different device count is a new
+            # baseline (``world_change`` skip). Pre-elastic records
+            # carry no world_size but always recorded ``devices`` — the
+            # same number — so they normalize to it and stay comparable
+            # across the field's introduction; records with neither
+            # normalize to 0 ("unspecified").
+            "world": int(
+                detail.get("world_size") or detail.get("devices") or 0
+            ),
             "skip": skip,
             "delta_pct": None,
         }
@@ -136,6 +146,7 @@ def analyze(
                 and prev["dtypes"] == row["dtypes"]
                 and prev["spec_k"] == row["spec_k"]
                 and prev["replicas"] == row["replicas"]
+                and prev["world"] == row["world"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -161,10 +172,15 @@ def analyze(
                 row["skip"] = (
                     f"spec_change:k={prev['spec_k']}->k={row['spec_k']}"
                 )
-            elif prev is not None:
+            elif prev is not None and prev["replicas"] != row["replicas"]:
                 row["skip"] = (
                     f"replica_change:{prev['replicas']}"
                     f"->{row['replicas']}"
+                )
+            elif prev is not None:
+                row["skip"] = (
+                    f"world_change:{prev['world'] or 'unspecified'}"
+                    f"->{row['world'] or 'unspecified'}"
                 )
             if row["skip"] is None or "_change" in str(row["skip"]):
                 # A protocol/platform transition row is not COMPARED,
@@ -175,6 +191,7 @@ def analyze(
                     "round": e["round"], "value": value,
                     "platform": row["platform"], "dtypes": row["dtypes"],
                     "spec_k": row["spec_k"], "replicas": row["replicas"],
+                    "world": row["world"],
                 }
         rows.append(row)
     return {

@@ -13,12 +13,18 @@ injector) and ``docs/ROBUSTNESS.md`` (failure model). Three actions:
   fall-back-to-previous-valid restore.
 * ``exit-codes`` — print the exit-code taxonomy the restart supervisor
   enforces (which world exits are retried, which are terminal).
+* ``elastic-drill`` — emit a canned shrink→resume→grow ``FAULT_PLAN``
+  for the elastic supervisor (``launch.py --elastic``): a ``shrink``
+  preemption at ``--step`` losing ``--ranks`` processes, with capacity
+  restored either ``--restore-secs`` later (wall clock) or once the
+  shrunken world completes ``--restore-step`` (deterministic drills).
 
 Usage::
 
     python scripts/faultgen.py validate "kill:step=3,rank=1;nan:step=2"
     python scripts/faultgen.py corrupt-latest /path/to/model_dir
     python scripts/faultgen.py exit-codes
+    python scripts/faultgen.py elastic-drill --step 3 --restore-step 6
 """
 
 import argparse
@@ -49,8 +55,19 @@ def _cmd_validate(args) -> int:
             detail = f" for {f.secs:g}s"
         elif f.kind == "exit":
             detail = f" with code {f.code}"
+        elif f.kind == "shrink":
+            who = f"the top {f.ranks} process(es)"
+            detail = " (capacity file updated, casualties SIGKILLed)"
+        elif f.kind == "restore_capacity":
+            if f.step == 0:
+                print(
+                    f"  {'restore_capacity':<7s} full capacity {f.secs:g}s "
+                    f"after the shrink (wall clock)"
+                )
+                continue
+            detail = " (full capacity announced; run continues)"
         print(
-            f"  {f.kind:<5s} {who} after optimizer step {f.step}{detail}"
+            f"  {f.kind:<7s} {who} after optimizer step {f.step}{detail}"
         )
     return 0
 
@@ -71,6 +88,33 @@ def _cmd_corrupt_latest(args) -> int:
     return 0
 
 
+def _cmd_elastic_drill(args) -> int:
+    """Emit (and validate) the canned shrink→resume→grow plan."""
+    if args.restore_step is not None:
+        restore = f"restore_capacity:step={args.restore_step}"
+    else:
+        restore = f"restore_capacity:secs={args.restore_secs:g}"
+    plan = f"shrink:step={args.step},ranks={args.ranks};{restore}"
+    try:
+        faults.parse_fault_plan(plan)
+    except ValueError as e:  # defensive: bad --step/--ranks combos
+        print(f"invalid drill plan {plan!r}: {e}", file=sys.stderr)
+        return 2
+    print(plan)
+    if args.verbose:
+        print(
+            "# run under the elastic supervisor, e.g.:\n"
+            "#   python launch.py -n 2 --elastic --max-restarts 2 \\\n"
+            "#       --grow-check-every-s 1 --obs-dir runs/drill \\\n"
+            f"#       --env FAULT_PLAN='{plan}' \\\n"
+            "#       --env CHECKPOINT_EVERY_STEPS=1 --env "
+            "CHECKPOINT_ASYNC=0 \\\n"
+            "#       --env DATA_TOPOLOGY=global train.py",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def _cmd_exit_codes(args) -> int:
     rows = [
         faults.classify_exit(rc)
@@ -80,6 +124,7 @@ def _cmd_exit_codes(args) -> int:
             faults.EXIT_TIMEOUT,
             faults.EXIT_HUNG,
             faults.EXIT_INTERRUPTED,
+            faults.EXIT_RESIZE,  # elastic world-resize handover
             -9,   # SIGKILL (preemption / OOM-kill)
             -15,  # SIGTERM
             1,    # generic crash
@@ -110,6 +155,34 @@ def main(argv=None) -> int:
 
     e = sub.add_parser("exit-codes", help="print the exit-code taxonomy")
     e.set_defaults(fn=_cmd_exit_codes)
+
+    d = sub.add_parser(
+        "elastic-drill",
+        help="emit a canned shrink->resume->grow FAULT_PLAN "
+        "(launch.py --elastic)",
+    )
+    d.add_argument(
+        "--step", type=int, default=3,
+        help="global step after which the shrink preemption fires",
+    )
+    d.add_argument(
+        "--ranks", type=int, default=1, help="processes lost by the shrink"
+    )
+    d.add_argument(
+        "--restore-step", type=int, default=None,
+        help="global step at which the shrunken world announces restored "
+        "capacity (deterministic; wins over --restore-secs)",
+    )
+    d.add_argument(
+        "--restore-secs", type=float, default=30.0,
+        help="wall-clock seconds after the shrink until capacity returns "
+        "(default 30)",
+    )
+    d.add_argument(
+        "--verbose", action="store_true",
+        help="also print the launch.py invocation recipe to stderr",
+    )
+    d.set_defaults(fn=_cmd_elastic_drill)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -31,6 +31,16 @@ def main() -> None:
     if cache:  # lets the supervisor e2e assert the per-attempt suffix
         print(f"FAULT_CHILD_CACHE_DIR {rank} {cache}", flush=True)
 
+    if os.environ.get("ELASTIC"):  # elastic drills assert the rescale
+        print(
+            f"FAULT_CHILD_WORLD rank={rank} "
+            f"world={os.environ.get('DDL_NUM_PROCESSES', '1')} "
+            f"batch={os.environ.get('BATCHSIZE', '-')} "
+            f"accum={os.environ.get('ACCUM_STEPS', '-')} "
+            f"lr_world={os.environ.get('LR_WORLD_SIZE', '-')}",
+            flush=True,
+        )
+
     start = 0
     if path and os.path.exists(path):
         start = int(open(path).read().strip() or 0)

@@ -11,6 +11,7 @@ criterion, riding the repo's determinism contract).
 """
 
 import hashlib
+import os
 import sys
 
 from distributeddeeplearning_tpu.parallel import distributed
@@ -28,11 +29,50 @@ def main() -> None:
     from distributeddeeplearning_tpu.training import loop
 
     config = TrainConfig.from_env()
-    model = get_model(config.model, **config.model_kwargs())
+    if config.model.startswith("lm"):
+        # Token models (the elastic oracles use lm_tiny: no BatchNorm,
+        # so the shrink-with-accum-rescale trajectory is provably
+        # ULP-equal; BN's rsqrt amplifies reassociation noise past any
+        # useful bound): the same synthetic contract, token edition.
+        from distributeddeeplearning_tpu.data.synthetic import (
+            SyntheticTokenDataset,
+        )
+
+        import jax as _jax
+
+        data = SyntheticTokenDataset(
+            length=config.fake_data_length,
+            global_batch_size=config.global_batch_size,
+            seq_len=int(os.environ.get("SEQ_LEN", "16")),
+            vocab_size=config.num_classes,
+            seed=config.seed,
+            process_index=_jax.process_index(),
+            process_count=_jax.process_count(),
+            topology=config.data_topology,
+        )
+        model = get_model(
+            config.model,
+            num_classes=config.num_classes,
+            dtype=config.compute_dtype,
+            max_seq_len=data.seq_len,
+        )
+    else:
+        data = make_dataset(config, train=True)
+        model = get_model(config.model, **config.model_kwargs())
     result = loop.fit(
-        model, config, make_dataset(config, train=True),
-        add_default_logger=False,
+        model, config, data, add_default_logger=False,
     )
+
+    # Loss trajectory (hex floats: exact, greppable) — the elastic
+    # oracles compare the post-resume trajectory of a shrunken world
+    # against an uninterrupted fixed-world run at f32-ULP tolerance.
+    for h in result.history:
+        if "loss" in h:
+            print(
+                f"FT_EPOCH_LOSS {jax.process_index()} "
+                f"{int(h['global_step'])} {float(h['loss']).hex()}",
+                flush=True,
+            )
 
     # Bitwise param fingerprint. Params are replicated over the mesh in
     # these oracles (dp engine; pjit on a data-only mesh), so the first
@@ -40,6 +80,16 @@ def main() -> None:
     host_params = jax.tree.map(
         lambda a: np.asarray(a.addressable_data(0)), result.state.params
     )
+    if os.environ.get("FT_PARAMS_OUT") and jax.process_index() == 0:
+        # Numeric dump for the ULP-tolerance oracles (an elastic
+        # shrink's accum rescale re-associates reductions, so the
+        # trajectory is f32-ULP-close, not bitwise — the SHA below
+        # serves the bitwise fixed-world oracles).
+        leaves = jax.tree_util.tree_leaves_with_path(host_params)
+        np.savez(
+            os.environ["FT_PARAMS_OUT"],
+            **{str(path): leaf for path, leaf in leaves},
+        )
     digest = hashlib.sha256()
     leaves = jax.tree_util.tree_leaves_with_path(host_params)
     for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):

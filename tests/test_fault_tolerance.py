@@ -17,6 +17,7 @@ Tiers:
   guard halting a supervised world with the non-retryable code.
 """
 
+import json
 import os
 import re
 import shutil
@@ -300,6 +301,201 @@ def test_resume_equivalence_across_supervised_restart(engine, tmp_path):
     shas_b = _shas(out_b)
     assert set(shas_b) == {"0", "1"}, out_b[-2000:]
     assert shas_b == shas_a, (shas_a, shas_b)  # bitwise-equal final params
+
+
+def test_corrupt_latest_falls_back_across_topologies(tmp_path, devices):
+    """Corrupt-checkpoint fallback under RESHARDING: step checkpoints
+    written from the 8-device mesh, the newest truncated (preemption
+    mid-write), then restored at a DIFFERENT device count — the manager
+    must fall back past the corrupt step onto the new topology, manifest
+    intact."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributeddeeplearning_tpu import faults
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+    from distributeddeeplearning_tpu.training.checkpoint import (
+        build_manifest,
+    )
+
+    mesh8 = create_mesh(devices=devices)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def tree(mesh, v):
+        return {
+            "w": jax.device_put(
+                jnp.full((16,), float(v), jnp.float32),
+                NamedSharding(mesh, P("data")),
+            ),
+            "b": jax.device_put(
+                jnp.full((4,), float(v) * 10, jnp.float32),
+                NamedSharding(mesh, P()),
+            ),
+        }
+
+    mgr = CheckpointManager(
+        ckpt_dir, save_every_steps=2, async_save=False, max_to_keep=10
+    )
+    for s in (2, 4):
+        assert mgr.save_step(
+            s, tree(mesh8, s),
+            manifest=build_manifest(
+                global_step=s, steps_per_epoch=4, effective_batch=16,
+                world_size=8,
+            ),
+        )
+    mgr.close()
+    assert faults.corrupt_latest_checkpoint(ckpt_dir)
+
+    for n_dev in (1, 4):
+        sub = create_mesh(devices=devices[:n_dev])
+        mgr2 = CheckpointManager(
+            ckpt_dir, save_every_steps=2, async_save=False
+        )
+        state, epoch, skip = mgr2.maybe_restore_at(
+            tree(sub, 0), steps_per_epoch=4
+        )
+        assert (epoch, skip) == (0, 2)  # fell back from 4 to 2
+        np.testing.assert_array_equal(
+            np.asarray(state["w"]), np.full(16, 2.0)
+        )
+        assert mgr2.last_manifest["global_step"] == 2
+        assert mgr2.last_manifest["world_size"] == 8
+        assert set(jax.tree.leaves(state)[0].sharding.device_set) <= set(
+            sub.devices.flat
+        )
+        mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# Heavy: the ISSUE 11 elastic drill (2-OS-process world, shrink -> grow)
+# ---------------------------------------------------------------------------
+
+def _losses(out):
+    """rank-0 FT_EPOCH_LOSS lines -> {global_step: loss} (hex-exact)."""
+    return {
+        int(s): float.fromhex(v)
+        for r, s, v in re.findall(
+            r"FT_EPOCH_LOSS (\d+) (\d+) (\S+)", out
+        )
+        if r == "0"
+    }
+
+
+def test_elastic_supervised_shrink_grow_e2e(tmp_path):
+    """The ISSUE 11 acceptance drill: a supervised 2-process lm_tiny
+    world loses rank 1 mid-epoch (shrink preemption). The elastic
+    supervisor relaunches at world 1 with BATCHSIZE/ACCUM_STEPS doubled
+    (effective batch constant, LR world pinned), re-sharding from the
+    topology-independent step checkpoint. The shrunken world announces
+    restored capacity at a later step; the grow poller stops it and the
+    full-size world resumes, re-sharding again. The post-resume loss
+    trajectory and final params match an uninterrupted fixed-world run
+    at f32-ULP (the accum rescale re-associates reductions — the
+    documented ISSUE-3 semantics; bitwise is mathematically
+    unavailable)."""
+    base = [
+        "--num-processes", "2",
+        "--devices-per-process", "2",
+        "--platform", "cpu",
+        "--timeout", "540",
+    ]
+    env = dict(
+        MODEL="lm_tiny",
+        NUM_CLASSES="64",
+        SEQ_LEN="16",
+        COMPUTE_DTYPE="float32",
+        WEIGHT_DECAY="0",
+        BATCHSIZE="2",
+        FAKE_DATA_LENGTH="64",   # global batch 8 -> 8 steps/epoch
+        EPOCHS="2",
+        ENGINE="dp",
+        CHECKPOINT_ASYNC="0",
+        DATA_TOPOLOGY="global",  # world-size-independent stream
+    )
+
+    def env_args(extra):
+        out = []
+        for k, v in {**env, **extra}.items():
+            out += ["--env", f"{k}={v}"]
+        return out
+
+    # Run A: uninterrupted fixed world.
+    res_a = _run_launcher(
+        [*base, *env_args({"FT_PARAMS_OUT": str(tmp_path / "ref.npz")}),
+         "tests/_ft_child.py"]
+    )
+    out_a = res_a.stdout + res_a.stderr
+    assert res_a.returncode == 0, out_a[-4000:]
+    losses_a = _losses(out_a)
+    assert set(losses_a) == {8, 16}, out_a[-2000:]
+
+    # Run B: the elastic drill. shrink after step 3 (mid-epoch-0),
+    # capacity restored once the shrunken world completes step 6.
+    res_b = _run_launcher(
+        [
+            *base,
+            "--max-restarts", "2",
+            "--restart-backoff", "0.1",
+            "--elastic",
+            "--min-world-size", "1",
+            "--grow-check-every-s", "0.2",
+            "--obs-dir", str(tmp_path / "run"),
+            *env_args({
+                "MODEL_DIR": str(tmp_path / "b_ckpt"),
+                "CHECKPOINT_EVERY_STEPS": "1",
+                "CHECKPOINT_KEEP": "30",
+                "FAULT_PLAN": (
+                    "shrink:step=3,rank=1,ranks=1;restore_capacity:step=6"
+                ),
+                "FT_PARAMS_OUT": str(tmp_path / "elastic.npz"),
+            }),
+            "tests/_ft_child.py",
+        ]
+    )
+    out_b = res_b.stdout + res_b.stderr
+    assert res_b.returncode == 0, out_b[-4000:]
+    # the shrink was classified and the world relaunched HALVED with the
+    # integer rescale announced
+    assert "rc=-9, signal_SIGKILL" in out_b
+    assert (
+        "supervisor: elastic world 1/2 processes — BATCHSIZE 2->4, "
+        "ACCUM_STEPS 1->2" in out_b
+    ), out_b[-4000:]
+    # the shrunken world resumed MID-epoch from the step checkpoint
+    assert re.search(r"resuming from epoch 0 step [3-9]", out_b), out_b[-4000:]
+    # grow-back: coordinated resize stop, full world resumed
+    assert "supervisor: world resize 1 -> 2" in out_b, out_b[-4000:]
+    assert "no restart budget consumed" in out_b
+
+    # Oracle: the post-resume trajectory matches the uninterrupted run
+    # at f32-ULP (final full epoch is entirely post-resume)...
+    losses_b = _losses(out_b)
+    assert 16 in losses_b, (losses_b, out_b[-2000:])
+    np.testing.assert_allclose(
+        losses_b[16], losses_a[16], rtol=1e-4, atol=1e-6
+    )
+    # ...and so do the final params (both ranks bitwise-agree on them
+    # inside run B — the grow-back restore is bitwise-faithful).
+    shas_b = _shas(out_b)
+    assert set(shas_b) == {"0", "1"} and shas_b["0"] == shas_b["1"]
+    ref_np = np.load(str(tmp_path / "ref.npz"))
+    ela_np = np.load(str(tmp_path / "elastic.npz"))
+    assert set(ref_np.files) == set(ela_np.files)
+    for k in ref_np.files:
+        np.testing.assert_allclose(
+            ela_np[k], ref_np[k], rtol=2e-4, atol=2e-7, err_msg=k
+        )
+    # supervisor record carries the per-attempt world sizes
+    recs = [
+        json.loads(ln)
+        for ln in open(tmp_path / "run" / "events-supervisor.jsonl")
+    ]
+    starts = [
+        r["labels"]["world_size"] for r in recs
+        if r.get("name") == "attempt_start"
+    ]
+    assert starts[:2] == [2, 1] and starts[-1] == 2, starts
 
 
 def test_nan_guard_halts_supervised_world(tmp_path):
