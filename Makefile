@@ -24,7 +24,8 @@ PY                ?= python
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
         obs-watch bench-trend accum-memory fault-suite elastic-drill \
-        serve-bench serve-bench-spec fleet-bench native \
+        serve-bench serve-bench-spec fleet-bench stream-shards \
+        stream-bench native \
         provision setup submit stream status stop teardown
 
 ## Image tier (reference 00_CreateImageAndTest + Makefile build/push)
@@ -110,6 +111,18 @@ fleet-bench:	## multi-replica fleet: 1 vs SERVE_REPLICAS(=2) replicas on a
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
 	$(PY) scripts/accum_memory.py
+
+stream-shards:	## local streamed-shard fixture: seeded token shards + index
+	## under stream_fixture/tokens (DATA_FORMAT=stream smoke target;
+	## scripts/streamgen.py builds real corpora the same way)
+	$(PY) scripts/streamgen.py tokens --out stream_fixture/tokens \
+	    --records 512 --seq-len 64 --vocab 256 --shard-records 128
+
+stream-bench:	## streamed pretrain -> checkpoint -> SlotEngine serve e2e:
+	## gates restored-params round trip, manifest data_cursor, and
+	## served streams token-equal to inference.generate
+	## (docs/DATA.md; lm_stream recertify row)
+	$(PY) scripts/stream_bench.py
 
 heavy-refresh:	## prune tests/heavy_tests.txt against --collect-only + print tier numbers
 	$(PY) scripts/heavy_refresh.py

@@ -115,10 +115,26 @@ class TrainConfig:
     fake_data_length: int = IMAGENET_TRAIN_LENGTH
     data_dir: Optional[str] = None
     val_data_dir: Optional[str] = None
-    # Real-data pipeline: "auto" detects TFRecord shards vs an ImageFolder
-    # tree; force with "imagefolder" | "tfrecord" (tf.data reader) |
-    # "tfrecord-native" (first-party TF-free reader, native/ tier).
+    # Real-data pipeline: "auto" detects stream shards (a
+    # stream_index.json in DATA_DIR) vs TFRecord shards vs an
+    # ImageFolder tree; force with "stream" (sharded streaming reader
+    # with the O(1) checkpointable shuffle cursor, data/stream/) |
+    # "imagefolder" | "tfrecord" (tf.data reader) | "tfrecord-native"
+    # (first-party TF-free reader, native/ tier).
     data_format: str = "auto"
+    # Streamed-shard shuffle block (env STREAM_SHUFFLE_BLOCK,
+    # docs/DATA.md): the block-permutation granularity of the
+    # checkpointable global shuffle — records mix globally at block
+    # granularity and exactly within blocks; >= the record count
+    # degenerates to one exact global permutation.
+    stream_shuffle_block: int = 256
+    # Host-side read-ahead for streamed shards (env
+    # PREFETCH_HOST_BATCHES; 0 = off): a background thread keeps this
+    # many ASSEMBLED host batches ahead of staging, overlapping shard
+    # reads with compute and reporting the data.* gauges
+    # (docs/OBSERVABILITY.md). Distinct from prefetch_batches, which
+    # stages already-assembled batches into HBM.
+    prefetch_host_batches: int = 2
     validation: bool = False
     num_workers: int = 4  # Keras NUM_WORKERS (:44-46)
     # "thread" | "process" — the reference Keras MULTIPROCESSING knob
@@ -322,6 +338,10 @@ class TrainConfig:
             kw["remat"] = _str_to_bool(e["REMAT"])
         if "DATA_FORMAT" in e:
             kw["data_format"] = e["DATA_FORMAT"]
+        if "STREAM_SHUFFLE_BLOCK" in e:
+            kw["stream_shuffle_block"] = int(e["STREAM_SHUFFLE_BLOCK"])
+        if "PREFETCH_HOST_BATCHES" in e:
+            kw["prefetch_host_batches"] = int(e["PREFETCH_HOST_BATCHES"])
         if "OPTIMIZER" in e:
             kw["optimizer"] = e["OPTIMIZER"]
         if "LR_SCHEDULE" in e:

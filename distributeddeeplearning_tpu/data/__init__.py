@@ -66,6 +66,23 @@ def make_dataset(config, train: bool = True):
     root = config.data_dir if train else config.val_data_dir
     pattern = _tfrecord_pattern(root)  # one directory scan, reused below
     fmt = _resolve_data_format(config, root, pattern)
+    if fmt == "stream":
+        # Sharded streaming reader (data/stream/, docs/DATA.md): global
+        # process-count-independent batches + the O(1) checkpointable
+        # shuffle cursor; the index's kind picks token vs record shards.
+        from distributeddeeplearning_tpu.data.stream import (
+            open_stream_dataset,
+        )
+
+        return open_stream_dataset(
+            root,
+            global_batch_size=config.global_batch_size,
+            seed=config.seed if train else config.seed + 10_000,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            shuffle_block=config.stream_shuffle_block,
+            image_dtype=dtype,
+        )
     common = dict(
         global_batch_size=config.global_batch_size,
         image_size=config.image_size,
@@ -114,7 +131,8 @@ def _tfrecord_pattern(root: str) -> str:
 
 
 def _resolve_data_format(config, root: str, pattern: Optional[str] = None) -> str:
-    """``config.data_format``, with "auto" sniffing the layout: TFRecord
+    """``config.data_format``, with "auto" sniffing the layout: stream
+    shards (a ``stream_index.json`` in the directory) vs TFRecord
     shards (a glob, or a dir containing shard-named files) vs an
     ImageFolder class tree. The tf.data reader is preferred when
     TensorFlow imports; otherwise the native TF-free reader.
@@ -124,16 +142,23 @@ def _resolve_data_format(config, root: str, pattern: Optional[str] = None) -> st
     if pattern is None:
         pattern = _tfrecord_pattern(root)
     fmt = config.data_format
-    if fmt not in ("auto", "imagefolder", "tfrecord", "tfrecord-native"):
+    if fmt not in (
+        "auto", "stream", "imagefolder", "tfrecord", "tfrecord-native"
+    ):
         raise ValueError(
-            f"unknown data_format {fmt!r}; use auto | imagefolder | "
-            "tfrecord | tfrecord-native"
+            f"unknown data_format {fmt!r}; use auto | stream | "
+            "imagefolder | tfrecord | tfrecord-native"
         )
-    if fmt == "imagefolder":
+    if fmt in ("imagefolder", "stream"):
         return fmt
     if fmt == "auto":
         import os
         import re
+
+        from distributeddeeplearning_tpu.data.stream import is_stream_dir
+
+        if os.path.isdir(root) and is_stream_dir(root):
+            return "stream"
 
         looks_tfrecord = (
             pattern != root

@@ -242,6 +242,24 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
     )
     step_s = span_stats.get("step", {}).get("total_s", 0.0)
 
+    # Data-plane view (streamed shards + host prefetch, docs/DATA.md):
+    # consumer wait percentiles, buffer depth, delivery rate, and the
+    # resume cost — 0 skipped batches on a cursor stream (O(1) seek),
+    # the replayed count on legacy datasets.
+    data_plane = None
+    if any(
+        k.startswith("data.") for k in (*span_stats, *counters, *gauges)
+    ):
+        data_plane = {
+            "wait": span_stats.get("data.wait"),
+            "buffer_depth": gauges.get("data.buffer_depth"),
+            "bytes": counters.get("data.bytes", 0),
+            "bytes_per_s": gauges.get("data.bytes_per_s"),
+            "resume_skip_batches": gauges.get("data.resume_skip_batches"),
+            "resume_skip_ms": gauges.get("data.resume_skip_ms"),
+            "resume_seeks": points.get("resume_seek", 0),
+        }
+
     # Serving view (continuous-batching tier): how request time splits
     # across queue-wait vs prefill vs batched decode, plus occupancy.
     serving = None
@@ -310,6 +328,7 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         "points": points,
         "compile_s": compile_s,
         "step_s": step_s,
+        "data_plane": data_plane,
         "serving": serving,
         "slo": slo_by_obj or None,
         "max_epoch_skew_ms": max(skews) if skews else 0.0,
@@ -359,6 +378,36 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
     add("")
     add(f"compile vs step time: compile {summary['compile_s']:.3f}s, "
         f"step {summary['step_s']:.3f}s")
+    dp = summary.get("data_plane")
+    if dp:
+        add("")
+        add("data plane (streamed shards / host prefetch):")
+        w = dp.get("wait")
+        if w:
+            add(
+                f"  wait           n={w['count']:<6d} "
+                f"total {w['total_s']:8.3f}s  p50 {w['p50_ms']:8.2f}ms  "
+                f"p99 {w['p99_ms']:8.2f}ms"
+            )
+        parts = []
+        if dp.get("buffer_depth") is not None:
+            parts.append(f"buffer depth {dp['buffer_depth']:.0f}")
+        if dp.get("bytes_per_s"):
+            parts.append(f"{dp['bytes_per_s'] / 2**20:.1f} MiB/s")
+        if dp.get("bytes"):
+            parts.append(f"{dp['bytes'] / 2**20:.1f} MiB delivered")
+        if parts:
+            add("  " + ", ".join(parts))
+        skip = dp.get("resume_skip_batches")
+        if skip is not None:
+            how = (
+                "O(1) cursor seek" if (skip == 0 and dp.get("resume_seeks"))
+                else "O(step) prefix replay"
+            )
+            add(
+                f"  resume: {skip:.0f} batch(es) replayed in "
+                f"{dp.get('resume_skip_ms') or 0.0:.1f} ms ({how})"
+            )
     srv = summary.get("serving")
     if srv:
         add("")

@@ -91,6 +91,7 @@ def build_manifest(
     accum_steps: int = 1,
     world_size: Optional[int] = None,
     process_count: Optional[int] = None,
+    data_cursor: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The topology-independence contract, as data: where the run is
     (``epoch``/``step_in_epoch`` data cursor) and what geometry produced
@@ -98,9 +99,17 @@ def build_manifest(
     restore onto a different device count can (a) resume the stream at
     the right batch and (b) validate that the *math* is preserved —
     effective batch held constant via the ACCUM_STEPS rescale
-    (docs/ROBUSTNESS.md elasticity section)."""
+    (docs/ROBUSTNESS.md elasticity section).
+
+    ``data_cursor`` (streamed datasets, docs/DATA.md): the stream's own
+    O(1)-seekable position ``{seed, epoch, offset, ...}`` plus its
+    identity fields (record count, shuffle block, global batch) — what
+    lets resume re-enter the stream bitwise with ZERO prefix replay on
+    any process count, and lets a restore detect a cursor that
+    describes a *different* stream. Additive: manifests without it keep
+    the epoch/step_in_epoch decode (legacy datasets replay the prefix)."""
     spe = max(int(steps_per_epoch), 1)
-    return {
+    out = {
         "format": MANIFEST_FORMAT,
         "global_step": int(global_step),
         "epoch": int(global_step) // spe,
@@ -117,6 +126,9 @@ def build_manifest(
             else jax.process_count()
         ),
     }
+    if data_cursor:
+        out["data_cursor"] = dict(data_cursor)
+    return out
 
 
 def reshard_state(state: PyTree, like: PyTree) -> PyTree:

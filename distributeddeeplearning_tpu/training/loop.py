@@ -113,6 +113,16 @@ def resolve_engine(config, mesh=None):
         raise ValueError(
             f"DATA_TOPOLOGY={config.data_topology!r} (have process, global)"
         )
+    if config.stream_shuffle_block < 1:
+        raise ValueError(
+            f"STREAM_SHUFFLE_BLOCK must be >= 1, got "
+            f"{config.stream_shuffle_block}"
+        )
+    if config.prefetch_host_batches < 0:
+        raise ValueError(
+            f"PREFETCH_HOST_BATCHES must be >= 0, got "
+            f"{config.prefetch_host_batches}"
+        )
     if config.lr_world_size is not None and config.lr_world_size < 1:
         raise ValueError(
             f"LR_WORLD_SIZE must be >= 1, got {config.lr_world_size}"
@@ -340,6 +350,36 @@ def fit(
     global_step = start_epoch * steps_per_epoch + skip_steps
     injector = faults.FaultInjector.from_env()
 
+    # Checkpointable-stream contract (data/stream/, docs/DATA.md): a
+    # dataset exposing epoch_at + cursor seeks to any (epoch, step) in
+    # O(1) and serializes its position into the manifest's data_cursor,
+    # so mid-epoch resume skips the O(step) prefix replay entirely.
+    supports_cursor = callable(
+        getattr(train_data, "epoch_at", None)
+    ) and callable(getattr(train_data, "cursor", None))
+    if supports_cursor and ckpt is not None and config.resume:
+        saved_cursor = (getattr(ckpt, "last_manifest", None) or {}).get(
+            "data_cursor"
+        )
+        if saved_cursor:
+            live = train_data.cursor(start_epoch, skip_steps)
+            drift = {
+                k: (saved_cursor.get(k), live.get(k))
+                for k in ("seed", "records", "shuffle_block", "global_batch")
+                if saved_cursor.get(k) is not None
+                and saved_cursor.get(k) != live.get(k)
+            }
+            if drift:
+                log.warning(
+                    "checkpoint data_cursor describes a different stream "
+                    "(%s) — resume position is kept, but the continued "
+                    "stream is NOT the one the checkpoint was trained on",
+                    ", ".join(
+                        f"{k}: saved {a} != live {b}"
+                        for k, (a, b) in drift.items()
+                    ),
+                )
+
     def make_manifest(step_key: int):
         """Topology-independence record for a checkpoint at ``step_key``
         (training/checkpoint.build_manifest). Returned as a zero-arg
@@ -354,6 +394,16 @@ def fit(
                 effective_batch=int(global_batch),
                 accum_steps=int(
                     getattr(train_step, "accum_steps", config.accum_steps)
+                ),
+                # Streamed datasets (data/stream/): the O(1)-seekable
+                # stream position at this step — host ints only.
+                data_cursor=(
+                    train_data.cursor(
+                        step_key // steps_per_epoch,
+                        step_key % steps_per_epoch,
+                    )
+                    if supports_cursor
+                    else None
                 ),
                 # The RESOLVED mesh's device count (not the process-wide
                 # jax.device_count()): a sub-mesh world is smaller than
@@ -374,6 +424,19 @@ def fit(
     sync_start = hostsync.accountant().count
     warmup_pending = config.aot_warmup
     warmup_info: Dict[str, float] = {}
+
+    # Host read-ahead applies to datasets that opt in (the streamed
+    # shard readers set the marker; in-memory synthetic pools gain
+    # nothing from an extra thread).
+    host_prefetch_depth = (
+        config.prefetch_host_batches
+        if getattr(train_data, "host_prefetch", False)
+        else 0
+    )
+    if host_prefetch_depth:
+        from distributeddeeplearning_tpu.data.stream import (
+            prefetch as stream_prefetch,
+        )
 
     history: List[Dict[str, float]] = []
     # Throughput accounting counts what the dataset actually delivers
@@ -407,33 +470,64 @@ def fit(
         # ride the compiled step (donated), so epoch statistics build up
         # in HBM and the loop stays sync-free between epoch boundaries.
         acc = init_accumulator(mesh) if accumulates else None
-        batches = train_data.epoch(epoch)
-        if epoch == start_epoch and skip_steps:
-            # Mid-epoch resume: the dataset's epoch stream is
-            # deterministic in (seed, epoch), so dropping the first k
-            # batches — before any staging — replays exactly the part of
-            # the epoch the checkpoint had not yet covered. The skip is
-            # consumed EAGERLY and timed: replaying an epoch prefix is
-            # O(step-in-epoch) host work, and the data.resume_skip
-            # span/gauges make that cost visible instead of smearing it
-            # into the first step (the hook for a checkpointable stream
-            # that seeks in O(1) — docs/DATA.md, ROADMAP item 5).
-            skip_t0 = time.monotonic()
-            batches = iter(batches)
-            skipped = sum(
-                1 for _ in itertools.islice(batches, skip_steps)
-            )
-            skip_s = time.monotonic() - skip_t0
+        if epoch == start_epoch and skip_steps and supports_cursor:
+            # Checkpointable stream (data/stream/, docs/DATA.md): the
+            # manifest's data_cursor decodes to (epoch, step) and the
+            # dataset SEEKS there — a pure index computation, zero
+            # skipped records read, zero prefix replay. The gauge the
+            # legacy path fills with the replayed-batch count reports 0
+            # here by design: that 0 IS the O(1)-resume contract the
+            # oracle (tests/test_stream.py) pins.
+            seek_t0 = time.monotonic()
+            batches = train_data.epoch_at(epoch, skip_steps)
+            seek_s = time.monotonic() - seek_t0
             bus.span_event(
-                "data.resume_skip", skip_s, epoch=epoch, skipped=skipped
+                "data.resume_seek", seek_s, epoch=epoch, offset=skip_steps
             )
-            bus.gauge("data.resume_skip_batches", float(skipped))
-            bus.gauge("data.resume_skip_ms", skip_s * 1000.0)
-            bus.point("resume_skip", epoch=epoch, skipped=skip_steps)
+            bus.gauge("data.resume_skip_batches", 0.0)
+            bus.gauge("data.resume_skip_ms", seek_s * 1000.0)
+            bus.point("resume_seek", epoch=epoch, offset=skip_steps)
             log.info(
-                "resume replayed %d skipped batch(es) in %.1f ms "
-                "(O(step) epoch-prefix replay; docs/DATA.md)",
-                skipped, skip_s * 1000.0,
+                "resume sought to epoch %d step %d in %.2f ms "
+                "(O(1) stream cursor; no prefix replay — docs/DATA.md)",
+                epoch, skip_steps, seek_s * 1000.0,
+            )
+        else:
+            batches = train_data.epoch(epoch)
+            if epoch == start_epoch and skip_steps:
+                # Mid-epoch resume, legacy datasets: the epoch stream is
+                # deterministic in (seed, epoch), so dropping the first
+                # k batches — before any staging — replays exactly the
+                # part of the epoch the checkpoint had not yet covered.
+                # The skip is consumed EAGERLY and timed: replaying an
+                # epoch prefix is O(step-in-epoch) host work, and the
+                # data.resume_skip span/gauges make that cost visible
+                # instead of smearing it into the first step.
+                skip_t0 = time.monotonic()
+                batches = iter(batches)
+                skipped = sum(
+                    1 for _ in itertools.islice(batches, skip_steps)
+                )
+                skip_s = time.monotonic() - skip_t0
+                bus.span_event(
+                    "data.resume_skip", skip_s, epoch=epoch, skipped=skipped
+                )
+                bus.gauge("data.resume_skip_batches", float(skipped))
+                bus.gauge("data.resume_skip_ms", skip_s * 1000.0)
+                bus.point("resume_skip", epoch=epoch, skipped=skip_steps)
+                log.info(
+                    "resume replayed %d skipped batch(es) in %.1f ms "
+                    "(O(step) epoch-prefix replay; docs/DATA.md)",
+                    skipped, skip_s * 1000.0,
+                )
+        if host_prefetch_depth:
+            # Host-overlapped read-ahead (data/stream/prefetch.py): the
+            # shard-read/assemble leg runs on a background thread,
+            # instrumented as data.wait / data.buffer_depth /
+            # data.bytes_per_s; prefetch_to_device below still owns the
+            # host->HBM staging leg.
+            batches = stream_prefetch.host_prefetch(
+                batches, depth=host_prefetch_depth
             )
         for batch in prefetch_to_device(
             batches, mesh, size=config.prefetch_batches,

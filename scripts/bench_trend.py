@@ -13,9 +13,12 @@ but skipped** — as are legacy outage records (``error`` / value ≤ 0
 with no tier), cross-platform pairs, pairs whose
 ``kv_dtype``/``weight_dtype`` changed (a re-quantized protocol is a new
 baseline, not a regression; records predating the quantized tier count
-as the native "bf16" config), and pairs whose ``spec_k`` changed (a
+as the native "bf16" config), pairs whose ``spec_k`` changed (a
 re-speculated protocol likewise — records predating the speculative
-tier count as ``spec_k=0``).
+tier count as ``spec_k=0``), and pairs whose ``data_format`` changed
+(synthetic pool vs streamed shards is a different input pipeline —
+``data_change`` skip; records predating the streamed tier count as the
+native synthetic reader).
 
 A drop > ``--threshold`` (default 10%) between *consecutive comparable*
 records of the same metric+platform exits nonzero — the CI tripwire
@@ -119,6 +122,13 @@ def analyze(
             # a regression; records predating the speculative tier ran
             # spec_k=0 and stay comparable. Same treatment as dtypes.
             "spec_k": int(detail.get("spec_k") or 0),
+            # A data-format change (synthetic pool -> streamed shards,
+            # or any reader swap) re-shapes the input side of a train
+            # protocol — different bytes, different host pipeline — so
+            # it is a protocol skip, not a regression. Records predating
+            # the streamed tier carry no field and normalize to the
+            # native synthetic reader.
+            "data_format": detail.get("data_format") or "native",
             # A replica-count change re-shapes the fleet protocol the
             # same way (aggregate throughput over N pools is a new
             # baseline); non-fleet records normalize to 1 replica.
@@ -147,6 +157,7 @@ def analyze(
                 and prev["spec_k"] == row["spec_k"]
                 and prev["replicas"] == row["replicas"]
                 and prev["world"] == row["world"]
+                and prev["data_format"] == row["data_format"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -177,6 +188,11 @@ def analyze(
                     f"replica_change:{prev['replicas']}"
                     f"->{row['replicas']}"
                 )
+            elif prev is not None and prev["data_format"] != row["data_format"]:
+                row["skip"] = (
+                    f"data_change:{prev['data_format']}"
+                    f"->{row['data_format']}"
+                )
             elif prev is not None:
                 row["skip"] = (
                     f"world_change:{prev['world'] or 'unspecified'}"
@@ -192,6 +208,7 @@ def analyze(
                     "platform": row["platform"], "dtypes": row["dtypes"],
                     "spec_k": row["spec_k"], "replicas": row["replicas"],
                     "world": row["world"],
+                    "data_format": row["data_format"],
                 }
         rows.append(row)
     return {

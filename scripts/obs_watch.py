@@ -119,6 +119,26 @@ def render(snapshot: dict) -> str:
         add(f"{'counter (window)':32s} {'sum':>10s} {'rate/s':>10s}")
         for name, c in sorted(counters.items()):
             add(f"{name:32s} {c['sum']:10.0f} {c['rate_per_s']:10.3f}")
+    # Data-plane line (streamed shards / host prefetch, docs/DATA.md):
+    # consumer wait p50/p99 over the window + the live buffer depth and
+    # delivery rate — is the pipeline keeping up with the step?
+    wait = spans.get("data.wait")
+    g = snapshot.get("gauges") or {}
+    depth = (g.get("data.buffer_depth") or {}).get("value")
+    rate = (g.get("data.bytes_per_s") or {}).get("value")
+    if wait or depth is not None or rate is not None:
+        parts = []
+        if wait:
+            parts.append(
+                f"wait p50 {wait['p50_ms']:.2f}ms p99 {wait['p99_ms']:.2f}ms"
+                f" (n={wait['count']})"
+            )
+        if depth is not None:
+            parts.append(f"buffer {depth:.0f}")
+        if rate is not None:
+            parts.append(f"{rate / 2**20:.1f} MiB/s")
+        add("")
+        add("data plane: " + "  ".join(parts))
     replicas = replica_rows(snapshot)
     gauges = snapshot.get("gauges") or {}
     if gauges:

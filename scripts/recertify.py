@@ -29,6 +29,10 @@ Protocols (all via bench.py's existing modes — no new measurement code):
                     replicas, multi-tenant closed
                     backlog: scaling + flat TTFT +
                     weighted fairness + bitwise parity
+    lm_stream       stream_bench pretrain-on-shards    tokens/sec
+                    (streamed reader, cursor manifest)
+                    -> restore -> SlotEngine greedy
+                    serve, gated vs inference.generate
 
 Usage::
 
@@ -145,6 +149,24 @@ PROTOCOLS = {
         "SERVE_REQUESTS": "48", "SERVE_MAX_NEW": "16",
         "SERVE_RATE_RPS": "0", "SERVE_BUCKETS": "8,16",
     },
+    # Streamed data plane + the first pretrain->serve artifact
+    # (docs/DATA.md): pretrain lm_tiny on seeded token shards through
+    # the stream reader (checkpointable shuffle cursor + host prefetch),
+    # restore the final checkpoint FROM DISK, serve it greedily through
+    # a SlotEngine — the row's JSON line carries training tokens/sec on
+    # the streamed reader plus the three gates (restored params bitwise
+    # == trained, manifest carries the data_cursor, served streams
+    # token-equal to inference.generate), and the script exits non-zero
+    # if any gate fails.
+    "lm_stream": {
+        "_script": "scripts/stream_bench.py",
+        "BENCH_MODEL": "lm_tiny",
+        "STREAM_RECORDS": "512", "STREAM_SEQ_LEN": "64",
+        "STREAM_VOCAB": "256", "STREAM_SHARD_RECORDS": "128",
+        "STREAM_SHUFFLE_BLOCK": "64", "STREAM_BATCH": "8",
+        "STREAM_EPOCHS": "2", "PREFETCH_HOST_BATCHES": "2",
+        "SERVE_MAX_NEW": "16", "SERVE_SLOTS": "4",
+    },
 }
 
 
@@ -168,6 +190,13 @@ _PROTOCOL_VARS = (
     "SERVE_FLEET_QUEUE_DEPTH", "SERVE_FLEET_QUANTUM",
     "SERVE_FLEET_MIN_SCALING", "SERVE_FLEET_SINGLE_CORE_MIN",
     "SERVE_FLEET_TTFT_MAX_RATIO", "SERVE_FLEET_FAIRNESS_TOL",
+    # Streamed data plane (lm_stream row + the DATA_* data-factory
+    # knobs, docs/DATA.md): joined here so an exported DATA_FORMAT or
+    # stream geometry can never leak into rows that leave it unset.
+    "STREAM_RECORDS", "STREAM_SEQ_LEN", "STREAM_VOCAB",
+    "STREAM_SHARD_RECORDS", "STREAM_SHUFFLE_BLOCK", "STREAM_BATCH",
+    "STREAM_EPOCHS", "SERVE_PROMPT_LEN",
+    "PREFETCH_HOST_BATCHES", "DATA_FORMAT", "DATA_TOPOLOGY",
 )
 
 

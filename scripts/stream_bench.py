@@ -1,0 +1,206 @@
+"""lm_stream recertify row: pretrain on token shards -> checkpoint ->
+serve the trained artifact.
+
+The first end-to-end pretrain→serve pipeline in the repo (ROADMAP
+item 5): every prior serving number decoded from *randomly initialised*
+params, and the trained artifact the training tier produces had never
+crossed into the serving tier. This protocol closes the loop on the
+streamed data plane (docs/DATA.md):
+
+1. build a seeded synthetic token shard set (``data/stream``) in a
+   temp dir — the same writer path ``scripts/streamgen.py`` exposes;
+2. pretrain ``BENCH_MODEL`` on it via ``DATA_FORMAT=stream`` semantics
+   (TokenStreamDataset + host prefetch + checkpointable shuffle
+   cursor), step-granular checkpoints ON so every manifest carries the
+   ``data_cursor``;
+3. restore the final checkpoint from disk into a fresh buffer tree
+   (portability: the restore path, not the in-memory state, feeds
+   serving) and **gate** that the restored params match the trained
+   ones bitwise and the manifest carries the stream cursor;
+4. load the restored params into a ``SlotEngine`` and serve greedy
+   continuations — **gate**: token streams match ``inference.generate``
+   on the same restored params exactly.
+
+JSON line: ``lm_stream_pretrain_tokens_per_sec`` (training throughput
+on the streamed reader), with the serve-match + cursor gates and the
+data-plane detail. Non-zero exit on any gate failure — recertify treats
+that as a failed row.
+
+Knobs (env): ``BENCH_MODEL`` (lm_tiny), ``STREAM_RECORDS`` (512),
+``STREAM_SEQ_LEN`` (64), ``STREAM_VOCAB`` (256), ``STREAM_SHARD_RECORDS``
+(128), ``STREAM_SHUFFLE_BLOCK`` (64), ``STREAM_BATCH`` (8, per device),
+``STREAM_EPOCHS`` (2), ``PREFETCH_HOST_BATCHES`` (2), ``SERVE_MAX_NEW``
+(16), ``SERVE_SLOTS`` (4), ``SERVE_PROMPT_LEN`` (8).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    model_name = os.environ.get("BENCH_MODEL", "lm_tiny")
+    records = _env_int("STREAM_RECORDS", 512)
+    seq_len = _env_int("STREAM_SEQ_LEN", 64)
+    vocab = _env_int("STREAM_VOCAB", 256)
+    shard_records = _env_int("STREAM_SHARD_RECORDS", 128)
+    shuffle_block = _env_int("STREAM_SHUFFLE_BLOCK", 64)
+    batch = _env_int("STREAM_BATCH", 8)
+    epochs = _env_int("STREAM_EPOCHS", 2)
+    host_prefetch = _env_int("PREFETCH_HOST_BATCHES", 2)
+    max_new = _env_int("SERVE_MAX_NEW", 16)
+    slots = _env_int("SERVE_SLOTS", 4)
+    prompt_len = _env_int("SERVE_PROMPT_LEN", 8)
+
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.data.stream import (
+        TokenStreamDataset,
+        synthetic_rows,
+        write_token_shards,
+    )
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.training import loop
+    from distributeddeeplearning_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="lm_stream_") as tmp:
+        shard_dir = os.path.join(tmp, "shards")
+        write_token_shards(
+            shard_dir,
+            synthetic_rows(records, seq_len=seq_len, vocab_size=vocab,
+                           seed=42),
+            seq_len=seq_len,
+            vocab_size=vocab,
+            shard_records=shard_records,
+        )
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        cfg = TrainConfig(
+            model=model_name,
+            num_classes=vocab,
+            batch_size_per_device=batch,
+            epochs=epochs,
+            compute_dtype="float32",
+            weight_decay=0.0,
+            log_every_steps=0,
+            data_format="stream",
+            data_dir=shard_dir,
+            fake=False,
+            stream_shuffle_block=shuffle_block,
+            prefetch_host_batches=host_prefetch,
+            model_dir=ckpt_dir,
+            checkpoint_every_steps=2,
+            checkpoint_async=False,
+        )
+        data = TokenStreamDataset(
+            shard_dir,
+            global_batch_size=cfg.global_batch_size,
+            seed=cfg.seed,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            shuffle_block=shuffle_block,
+        )
+        model = get_model(
+            model_name,
+            num_classes=vocab,
+            dtype="float32",
+            max_seq_len=max(seq_len, prompt_len + max_new),
+        )
+        result = loop.fit(model, cfg, data, add_default_logger=False)
+        train_tps = result.images_per_sec * seq_len  # rows/s x tokens/row
+
+        # Portability leg: restore the artifact FROM DISK and gate the
+        # round trip + the manifest's stream cursor.
+        mgr = CheckpointManager(ckpt_dir, save_every_steps=2)
+        restored = mgr.restore(
+            jax.tree.map(lambda x: jax.numpy.zeros_like(x), result.state)
+        )
+        manifest = mgr.last_manifest or {}
+        cursor = manifest.get("data_cursor")
+        mgr.close()
+        roundtrip_ok = all(
+            bool(np.array_equal(np.asarray(a), np.asarray(b)))
+            for a, b in zip(
+                jax.tree.leaves(jax.device_get(result.state.params)),
+                jax.tree.leaves(jax.device_get(restored.params)),
+            )
+        )
+
+        # Serve the trained artifact: greedy through the slot engine vs
+        # the sequential reference on the SAME restored params.
+        from distributeddeeplearning_tpu.inference import generate
+        from distributeddeeplearning_tpu.serving import SlotEngine
+
+        prompts = data.index.read(
+            "tokens", np.arange(slots)
+        )[:, :prompt_len].astype(np.int32)
+        engine = SlotEngine(
+            model, restored.params, num_slots=slots,
+            max_len=prompt_len + max_new,
+        )
+        served = np.asarray(
+            generate(
+                model, restored.params, prompts,
+                max_new_tokens=max_new, engine=engine,
+            )
+        )
+        reference = np.asarray(
+            generate(
+                model, restored.params, jax.numpy.asarray(prompts),
+                max_new_tokens=max_new,
+            )
+        )
+        serve_match = bool(np.array_equal(served, reference))
+
+    ok = roundtrip_ok and serve_match and cursor is not None and train_tps > 0
+    record = {
+        "metric": "lm_stream_pretrain_tokens_per_sec",
+        "value": round(train_tps, 1) if ok else 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # new scenario: no reference point
+        "host_sync_count": result.perf.get("host_sync_count"),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": jax.device_count(),
+            "data_format": "stream",
+            "records": records,
+            "seq_len": seq_len,
+            "vocab": vocab,
+            "shuffle_block": shuffle_block,
+            "epochs": epochs,
+            "per_device_batch": batch,
+            "prefetch_host_batches": host_prefetch,
+            "serve_match": serve_match,
+            "restore_roundtrip": roundtrip_ok,
+            "manifest_data_cursor": cursor,
+            "serve_max_new": max_new,
+            "serve_slots": slots,
+        },
+    }
+    print(json.dumps(record), flush=True)
+    if not ok:
+        print(
+            f"FAIL: roundtrip={roundtrip_ok} serve_match={serve_match} "
+            f"cursor={'present' if cursor else 'MISSING'} tps={train_tps}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

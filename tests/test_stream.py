@@ -1,0 +1,527 @@
+"""Streamed data plane oracles (data/stream/, docs/DATA.md).
+
+The contracts under test:
+
+* cursor seek is BITWISE the replayed stream (``epoch_at(e, k)`` ==
+  the tail of ``epoch(e)``) — the O(1)-resume foundation;
+* the delivered global batch is process-count-independent by
+  construction (1/2/4-process slices concatenate to the same batch,
+  and a mid-epoch cursor continues bitwise across world sizes) — the
+  elastic contract on real data;
+* mid-epoch checkpoint/restore through the manifest's ``data_cursor``
+  bitwise-continues training with ``data.resume_skip_batches == 0``
+  and no O(step) prefix replay;
+* host prefetch is math-neutral and adds zero host syncs
+  (SyncAccountant);
+* shard-index corruption is a clear, file-naming error;
+* the pretrain→checkpoint→serve pipeline: a ``SlotEngine`` loaded from
+  the restored checkpoint serves greedy tokens equal to
+  ``inference.generate``.
+"""
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.data.stream import (
+    INDEX_BASENAME,
+    BlockShuffle,
+    RecordStreamDataset,
+    StreamFormatError,
+    TokenStreamDataset,
+    corpus_to_rows,
+    host_prefetch,
+    load_index,
+    open_stream_dataset,
+    synthetic_records,
+    synthetic_rows,
+    write_record_shards,
+    write_token_shards,
+)
+
+VOCAB, T = 64, 8
+
+
+def _token_dir(tmp_path, n=64, seq=T, vocab=VOCAB, shard=16, seed=7):
+    d = str(tmp_path / f"tok{n}x{seq}")
+    if not os.path.isdir(d):
+        write_token_shards(
+            d, synthetic_rows(n, seq_len=seq, vocab_size=vocab, seed=seed),
+            seq_len=seq, vocab_size=vocab, shard_records=shard,
+        )
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Index + shard IO
+# ---------------------------------------------------------------------------
+
+def test_index_roundtrip_and_ordered_gather(tmp_path):
+    rows = synthetic_rows(50, seq_len=T, vocab_size=VOCAB, seed=3)
+    d = str(tmp_path / "s")
+    meta = write_token_shards(
+        d, rows, seq_len=T, vocab_size=VOCAB, shard_records=16
+    )
+    assert meta["total_records"] == 50
+    assert len(meta["shards"]) == 4  # 16+16+16+2
+    idx = load_index(d)
+    np.testing.assert_array_equal(idx.read("tokens", np.arange(50)), rows)
+    # order-preserving gather across shard boundaries, duplicates included
+    ids = np.array([49, 0, 17, 17, 33, 2])
+    np.testing.assert_array_equal(idx.read("tokens", ids), rows[ids])
+
+
+def test_corruption_is_a_clear_error(tmp_path):
+    d = _token_dir(tmp_path)
+    # truncated shard file: error names the file and both byte counts
+    victim = os.path.join(d, "shard-00001.tokens.bin")
+    with open(victim, "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(StreamFormatError, match="shard-00001.tokens.bin"):
+        load_index(d)
+    os.remove(victim)
+    with pytest.raises(StreamFormatError, match="missing"):
+        load_index(d)
+
+    # unreadable index JSON
+    d2 = str(tmp_path / "bad")
+    os.makedirs(d2)
+    with open(os.path.join(d2, INDEX_BASENAME), "w") as f:
+        f.write("{not json")
+    with pytest.raises(StreamFormatError, match="unreadable"):
+        load_index(d2)
+
+    # no index at all
+    with pytest.raises(StreamFormatError, match="no stream index"):
+        load_index(str(tmp_path / "nowhere"))
+
+    # wrong kind for the dataset class
+    d3 = str(tmp_path / "rec")
+    im, lb = synthetic_records(8, image_size=4, num_classes=2, seed=1)
+    write_record_shards(d3, (im, lb), image_size=4, num_classes=2,
+                        shard_records=4)
+    with pytest.raises(StreamFormatError, match="not a token stream"):
+        TokenStreamDataset(d3, global_batch_size=4)
+
+
+def test_stream_smaller_than_global_batch_refused(tmp_path):
+    d = _token_dir(tmp_path, n=8)
+    with pytest.raises(ValueError, match="8 records < global batch 16"):
+        TokenStreamDataset(d, global_batch_size=16)
+
+
+# ---------------------------------------------------------------------------
+# Shuffle: permutation + O(1) seek
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("block", [1, 7, 16, 64, 1000])
+def test_block_shuffle_is_a_permutation(block):
+    sh = BlockShuffle(64, seed=11, block_size=block)
+    seen = []
+    for epoch in (0, 1, 2):
+        p = sh.epoch_order(epoch).positions(0, 64)
+        assert sorted(p) == list(range(64))
+        seen.append(tuple(p))
+    # epochs reshuffle (vanishingly unlikely to collide for block < n)
+    if block < 64:
+        assert len(set(seen)) == 3
+
+
+@pytest.mark.parametrize("block", [1, 7, 16, 1000])
+def test_block_shuffle_seek_equals_slice(block):
+    sh = BlockShuffle(64, seed=5, block_size=block)
+    full = sh.epoch_order(3).positions(0, 64)
+    for start, stop in ((0, 64), (20, 50), (63, 64), (10, 10)):
+        np.testing.assert_array_equal(
+            sh.epoch_order(3).positions(start, stop), full[start:stop]
+        )
+
+
+def test_giant_block_is_one_exact_global_permutation():
+    # block >= n: the degenerate case IS a classic full shuffle
+    sh = BlockShuffle(40, seed=2, block_size=10_000)
+    assert sh.n_blocks == 1
+    p = sh.epoch_order(0).positions(0, 40)
+    assert sorted(p) == list(range(40)) and list(p) != list(range(40))
+
+
+# ---------------------------------------------------------------------------
+# Dataset: seek bitwise == replay, process-count independence
+# ---------------------------------------------------------------------------
+
+def test_epoch_at_bitwise_matches_replayed_stream(tmp_path):
+    ds = TokenStreamDataset(
+        _token_dir(tmp_path), global_batch_size=16, seed=5, shuffle_block=16
+    )
+    assert ds.steps_per_epoch == 4 and ds.seq_len == T
+    for epoch in (0, 2):
+        full = list(ds.epoch(epoch))
+        for k in (0, 1, 3, 4):
+            tail = list(ds.epoch_at(epoch, k))
+            assert len(tail) == len(full) - k
+            for (x, y), (rx, ry) in zip(tail, full[k:]):
+                np.testing.assert_array_equal(x, rx)
+                np.testing.assert_array_equal(y, ry)
+
+
+def test_global_batch_is_process_count_independent(tmp_path):
+    d = _token_dir(tmp_path)
+    one = TokenStreamDataset(d, global_batch_size=16, seed=9,
+                             shuffle_block=8)
+    full = list(one.epoch(1))
+    for pc in (2, 4):
+        shards = [
+            TokenStreamDataset(
+                d, global_batch_size=16, seed=9, shuffle_block=8,
+                process_index=i, process_count=pc,
+            )
+            for i in range(pc)
+        ]
+        iters = [s.epoch(1) for s in shards]
+        for x, y in full:
+            xs, ys = zip(*[next(it) for it in iters])
+            np.testing.assert_array_equal(np.concatenate(xs), x)
+            np.testing.assert_array_equal(np.concatenate(ys), y)
+
+
+def test_record_stream_process_count_independent_and_normalized(tmp_path):
+    d = str(tmp_path / "rec")
+    im, lb = synthetic_records(48, image_size=4, num_classes=8, seed=3)
+    write_record_shards(d, (im, lb), image_size=4, num_classes=8,
+                        shard_records=16)
+    one = RecordStreamDataset(d, global_batch_size=8, seed=4,
+                              image_dtype=np.uint8)
+    full = list(one.epoch(0))
+    halves = [
+        RecordStreamDataset(
+            d, global_batch_size=8, seed=4, image_dtype=np.uint8,
+            process_index=i, process_count=2,
+        )
+        for i in range(2)
+    ]
+    iters = [h.epoch(0) for h in halves]
+    for x, y in full:
+        xs, ys = zip(*[next(it) for it in iters])
+        np.testing.assert_array_equal(np.concatenate(xs), x)
+        np.testing.assert_array_equal(np.concatenate(ys), y)
+    # float staging normalizes on host (torchvision mean/sd), uint8 is raw
+    fl = RecordStreamDataset(d, global_batch_size=8, seed=4,
+                             image_dtype=np.float32)
+    fx, _ = next(iter(fl.epoch(0)))
+    assert fx.dtype == np.float32 and fx.min() < 0  # normalized, not raw
+
+def test_cursor_continues_bitwise_across_process_counts(tmp_path):
+    """The elastic-on-real-data oracle: a mid-epoch cursor written at
+    world 1 re-enters the stream at world 2 and 4 and the delivered
+    GLOBAL batches bitwise-continue the original stream."""
+    d = _token_dir(tmp_path)
+    one = TokenStreamDataset(d, global_batch_size=16, seed=13,
+                             shuffle_block=16)
+    full = list(one.epoch(0))
+    cur = one.cursor(0, 2)
+    assert (cur["epoch"], cur["offset"]) == (0, 2)
+    for pc in (2, 4):
+        shards = [
+            TokenStreamDataset(
+                d, global_batch_size=16, seed=cur["seed"], shuffle_block=16,
+                process_index=i, process_count=pc,
+            )
+            for i in range(pc)
+        ]
+        iters = [s.epoch_at(cur["epoch"], cur["offset"]) for s in shards]
+        for x, y in full[2:]:
+            xs, ys = zip(*[next(it) for it in iters])
+            np.testing.assert_array_equal(np.concatenate(xs), x)
+            np.testing.assert_array_equal(np.concatenate(ys), y)
+
+
+# ---------------------------------------------------------------------------
+# Host prefetch: math-neutral, zero host syncs
+# ---------------------------------------------------------------------------
+
+def test_host_prefetch_is_math_neutral_and_sync_free(tmp_path):
+    from distributeddeeplearning_tpu.utils import hostsync
+
+    ds = TokenStreamDataset(_token_dir(tmp_path), global_batch_size=16,
+                            seed=21, shuffle_block=16)
+    ref = list(ds.epoch(0))
+    before = hostsync.accountant().count
+    out = list(host_prefetch(ds.epoch(0), depth=3))
+    assert hostsync.accountant().count == before  # zero new host syncs
+    assert len(out) == len(ref)
+    for (x, y), (rx, ry) in zip(out, ref):
+        np.testing.assert_array_equal(x, rx)
+        np.testing.assert_array_equal(y, ry)
+    # depth<=0 passthrough and early-abandon shutdown both behave
+    assert len(list(host_prefetch(ds.epoch(0), depth=0))) == len(ref)
+    gen = host_prefetch(ds.epoch(0), depth=2)
+    next(gen)
+    gen.close()  # must not hang or leak the reader thread
+
+
+def test_host_prefetch_propagates_reader_errors(tmp_path):
+    def boom():
+        yield np.zeros((2, 2))
+        raise RuntimeError("shard read failed")
+
+    it = host_prefetch(boom(), depth=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="shard read failed"):
+        list(it)
+
+
+# ---------------------------------------------------------------------------
+# Factory resolution
+# ---------------------------------------------------------------------------
+
+def test_make_dataset_resolves_stream(tmp_path):
+    from distributeddeeplearning_tpu import data as data_factory
+    from distributeddeeplearning_tpu.config import TrainConfig
+
+    d = _token_dir(tmp_path)
+    for fmt in ("stream", "auto"):
+        cfg = TrainConfig(
+            fake=False, data_dir=d, data_format=fmt,
+            batch_size_per_device=2, stream_shuffle_block=16,
+        )
+        ds = data_factory.make_dataset(cfg, train=True)
+        assert isinstance(ds, TokenStreamDataset)
+        assert ds.global_batch_size == cfg.global_batch_size
+        assert ds.shuffle_block == 16
+    with pytest.raises(ValueError, match="stream"):
+        data_factory.make_dataset(
+            TrainConfig(fake=False, data_dir=d, data_format="sideways"),
+            train=True,
+        )
+
+
+def test_config_stream_knobs_env_and_validation(tmp_path):
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.training.loop import resolve_engine
+
+    cfg = TrainConfig.from_env(
+        {"STREAM_SHUFFLE_BLOCK": "512", "PREFETCH_HOST_BATCHES": "0",
+         "DATA_FORMAT": "stream"}
+    )
+    assert cfg.stream_shuffle_block == 512
+    assert cfg.prefetch_host_batches == 0
+    assert cfg.data_format == "stream"
+    with pytest.raises(ValueError, match="STREAM_SHUFFLE_BLOCK"):
+        resolve_engine(TrainConfig(stream_shuffle_block=0))
+    with pytest.raises(ValueError, match="PREFETCH_HOST_BATCHES"):
+        resolve_engine(TrainConfig(prefetch_host_batches=-1))
+
+
+# ---------------------------------------------------------------------------
+# Training-loop integration: O(1) resume from the manifest cursor
+# ---------------------------------------------------------------------------
+
+def _lm_cfg(**kw):
+    from distributeddeeplearning_tpu.config import TrainConfig
+
+    base = dict(
+        model="lm_tiny", num_classes=VOCAB, batch_size_per_device=2,
+        epochs=2, compute_dtype="float32", weight_decay=0.0,
+        log_every_steps=0, prefetch_host_batches=2,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _lm_fit(cfg, shard_dir, mesh8):
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.training import loop
+
+    data = TokenStreamDataset(
+        shard_dir, global_batch_size=cfg.global_batch_size, seed=cfg.seed,
+        shuffle_block=cfg.stream_shuffle_block,
+    )
+    model = get_model("lm_tiny", num_classes=VOCAB, dtype="float32",
+                      max_seq_len=T)
+    return loop.fit(model, cfg, data, mesh=mesh8, add_default_logger=False)
+
+
+def _events(obs_dir):
+    out = []
+    for name in os.listdir(obs_dir):
+        if name.startswith("events") and name.endswith(".jsonl"):
+            with open(os.path.join(obs_dir, name)) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    return out
+
+
+def test_resume_from_manifest_cursor_is_bitwise_with_zero_replay(
+    tmp_path, mesh8, monkeypatch
+):
+    """The ISSUE acceptance oracle: roll checkpoints back to a MID-epoch
+    step and resume — final params bitwise-equal to the uninterrupted
+    run, the resume SEEKS (resume_seek point, data.resume_skip_batches
+    == 0) and never replays the prefix (no resume_skip point)."""
+    from distributeddeeplearning_tpu import faults, obs
+    from distributeddeeplearning_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    d = _token_dir(tmp_path)
+    ref = _lm_fit(_lm_cfg(), d, mesh8)
+
+    ck = str(tmp_path / "ck")
+    cfg = _lm_cfg(model_dir=ck, checkpoint_every_steps=3,
+                  checkpoint_async=False)
+    full = _lm_fit(cfg, d, mesh8)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(ref.state.params)),
+        jax.tree.leaves(jax.device_get(full.state.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+    # "preempt at step 6" (4 steps/epoch -> mid-epoch-1, skip 2)
+    steps = faults.checkpoint_steps(ck)
+    assert 6 in steps, steps
+    for s in steps:
+        if s > 6:
+            shutil.rmtree(os.path.join(ck, str(s)))
+
+    obs_dir = str(tmp_path / "obs")
+    monkeypatch.setenv("OBS_DIR", obs_dir)
+    obs.reset()
+    try:
+        resumed = _lm_fit(cfg, d, mesh8)
+        obs.flush()
+    finally:
+        monkeypatch.delenv("OBS_DIR")
+        obs.reset()
+    assert resumed.history[0]["epoch_images"] == 32  # 2 of 4 batches left
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(ref.state.params)),
+        jax.tree.leaves(jax.device_get(resumed.state.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+    evs = _events(obs_dir)
+    points = [e.get("name") for e in evs if e.get("kind") == "point"]
+    assert "resume_seek" in points      # the O(1) path ran...
+    assert "resume_skip" not in points  # ...and the O(step) replay didn't
+    skip_gauges = [
+        e["value"] for e in evs
+        if e.get("kind") == "gauge"
+        and e.get("name") == "data.resume_skip_batches"
+    ]
+    assert skip_gauges and all(v == 0.0 for v in skip_gauges)
+    # data-plane instrumentation flowed through the same stream
+    assert any(
+        e.get("kind") == "span" and e.get("name") == "data.wait" for e in evs
+    )
+
+    # The restored manifest carried the stream cursor (decoded by ANY
+    # topology — the identity fields are what loop.fit cross-checks).
+    mgr = CheckpointManager(ck, save_every_steps=3)
+    template = jax.tree.map(lambda x: x, resumed.state)
+    mgr.restore(template, epoch=6)  # the mid-epoch key we resumed from
+    cur = (mgr.last_manifest or {}).get("data_cursor")
+    assert cur is not None
+    assert (cur["epoch"], cur["offset"]) == (1, 2)
+    assert cur["records"] == 64 and cur["seed"] == cfg.seed
+    # ... and the newest key (end of the resumed run) points at the
+    # start of the next epoch.
+    mgr.maybe_restore_at(template, steps_per_epoch=4)
+    end = (mgr.last_manifest or {}).get("data_cursor")
+    mgr.close()
+    assert end and (end["epoch"], end["offset"]) == (2, 0)
+
+
+# ---------------------------------------------------------------------------
+# streamgen CLI
+# ---------------------------------------------------------------------------
+
+def test_streamgen_cli_corpus_roundtrip(tmp_path, capsys):
+    from scripts import streamgen  # repo root on sys.path via conftest
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog. " * 30)
+    out = str(tmp_path / "shards")
+    rc = streamgen.main([
+        "tokens", "--out", out, "--corpus", str(corpus),
+        "--seq-len", "16", "--shard-records", "32",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["kind"] == "tokens" and summary["records"] > 0
+
+    idx = load_index(out)
+    # record 0 is the corpus head: byte-level identity round trip
+    raw = corpus.read_bytes()
+    np.testing.assert_array_equal(
+        idx.read("tokens", np.array([0]))[0],
+        np.frombuffer(raw[:17], np.uint8).astype(np.int32),
+    )
+    ds = open_stream_dataset(out, global_batch_size=8)
+    assert isinstance(ds, TokenStreamDataset) and ds.vocab_size == 256
+
+    rows = corpus_to_rows(b"0123456789", seq_len=4, stride=2)
+    assert rows.shape == (3, 5)
+    with pytest.raises(ValueError, match="too short"):
+        corpus_to_rows(b"abc", seq_len=16)
+
+
+# ---------------------------------------------------------------------------
+# Pretrain -> checkpoint -> serve (the lm_stream pipeline, compact)
+# ---------------------------------------------------------------------------
+
+def test_served_tokens_match_generate_after_restore(tmp_path, mesh8):
+    """The pretrain→serve oracle behind the lm_stream recertify row: a
+    SlotEngine loaded with the RESTORED-from-disk params serves greedy
+    continuations token-equal to ``inference.generate`` on the same
+    params."""
+    from distributeddeeplearning_tpu.inference import generate
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.serving import SlotEngine
+    from distributeddeeplearning_tpu.training import loop
+    from distributeddeeplearning_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    d = _token_dir(tmp_path, n=32)
+    ck = str(tmp_path / "ck")
+    cfg = _lm_cfg(epochs=1, model_dir=ck, checkpoint_every_steps=2,
+                  checkpoint_async=False)
+    data = TokenStreamDataset(
+        d, global_batch_size=cfg.global_batch_size, seed=cfg.seed,
+        shuffle_block=cfg.stream_shuffle_block,
+    )
+    model = get_model("lm_tiny", num_classes=VOCAB, dtype="float32",
+                      max_seq_len=T + 6)
+    trained = loop.fit(model, cfg, data, mesh=mesh8,
+                       add_default_logger=False)
+
+    mgr = CheckpointManager(ck, save_every_steps=2)
+    restored = mgr.restore(
+        jax.tree.map(lambda x: jax.numpy.zeros_like(x), trained.state)
+    )
+    assert (mgr.last_manifest or {}).get("data_cursor") is not None
+    mgr.close()
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(trained.state.params)),
+        jax.tree.leaves(jax.device_get(restored.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+    prompts = data.index.read("tokens", np.arange(2))[:, :4].astype(np.int32)
+    engine = SlotEngine(model, restored.params, num_slots=2, max_len=T + 6)
+    served = np.asarray(
+        generate(model, restored.params, prompts, max_new_tokens=4,
+                 engine=engine)
+    )
+    reference = np.asarray(
+        generate(model, restored.params, jax.numpy.asarray(prompts),
+                 max_new_tokens=4)
+    )
+    np.testing.assert_array_equal(served, reference)
