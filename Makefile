@@ -24,7 +24,7 @@ PY                ?= python
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
         obs-watch bench-trend accum-memory fault-suite elastic-drill \
-        serve-bench serve-bench-spec fleet-bench stream-shards \
+        serve-bench serve-bench-spec fleet-bench chaos-bench stream-shards \
         stream-bench native \
         provision setup submit stream status stop teardown
 
@@ -108,6 +108,15 @@ fleet-bench:	## multi-replica fleet: 1 vs SERVE_REPLICAS(=2) replicas on a
 	## program sets per replica (docs/SERVING.md fleet tier;
 	## serve_lm_fleet recertify row)
 	$(PY) scripts/fleet_bench.py
+
+chaos-bench:	## seeded mixed-verb fault storm over a closed 3-tenant
+	## backlog on 2+ replicas: every non-shed request must finish with
+	## bitwise splice parity, the corrupt injection detected+healed
+	## (never delivered), the flap crash-loop must open the breaker,
+	## program sets stay closed and p99 TTFT holds within the declared
+	## multiple (docs/ROBUSTNESS.md serving failure model;
+	## serve_lm_chaos recertify row; SERVE_CHAOS_PLAN/SERVE_CHAOS_SEED)
+	$(PY) scripts/chaos_bench.py
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
 	$(PY) scripts/accum_memory.py

@@ -171,6 +171,40 @@ FAULT_KINDS = (
 _INT_KEYS = ("step", "rank", "code", "ranks")
 
 
+def split_plan(text: str, kinds) -> List:
+    """Lexical layer of the FAULT_PLAN grammar family, shared with the
+    serving chaos plane (``serving/chaos.py`` speaks the same
+    ``kind:key=value,...;...`` surface with fleet verbs): split ``text``
+    into ``(raw, kind, [(key, value_str), ...])`` triples, validating
+    kind membership and key=value form. Semantic validation (which keys
+    a kind accepts, ranges) stays with each dialect's parser."""
+    out = []
+    for raw in (text or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, rest = raw.partition(":")
+        kind = kind.strip()
+        if kind not in kinds:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {raw!r} "
+                f"(have {', '.join(kinds)})"
+            )
+        pairs = []
+        for pair in rest.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise ValueError(
+                    f"fault directive {raw!r}: expected key=value, got {pair!r}"
+                )
+            k, v = (s.strip() for s in pair.split("=", 1))
+            pairs.append((k, v))
+        out.append((raw, kind, pairs))
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Fault:
     kind: str
@@ -184,27 +218,9 @@ class Fault:
 def parse_fault_plan(text: str) -> List[Fault]:
     """Parse a ``FAULT_PLAN`` string (module docstring grammar)."""
     faults: List[Fault] = []
-    for raw in (text or "").split(";"):
-        raw = raw.strip()
-        if not raw:
-            continue
-        kind, _, rest = raw.partition(":")
-        kind = kind.strip()
-        if kind not in FAULT_KINDS:
-            raise ValueError(
-                f"unknown fault kind {kind!r} in {raw!r} "
-                f"(have {', '.join(FAULT_KINDS)})"
-            )
+    for raw, kind, pairs in split_plan(text, FAULT_KINDS):
         kw: dict = {}
-        for pair in rest.split(","):
-            pair = pair.strip()
-            if not pair:
-                continue
-            if "=" not in pair:
-                raise ValueError(
-                    f"fault directive {raw!r}: expected key=value, got {pair!r}"
-                )
-            k, v = (s.strip() for s in pair.split("=", 1))
+        for k, v in pairs:
             if k not in ("step", "rank", "secs", "code", "ranks"):
                 raise ValueError(f"fault directive {raw!r}: unknown key {k!r}")
             if k == "ranks" and kind != "shrink":

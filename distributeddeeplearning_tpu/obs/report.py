@@ -309,6 +309,19 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
             "prefill": span_stats.get("serve.prefill"),
             "decode_step": span_stats.get("serve.decode_step"),
             "request": span_stats.get("serve.request"),
+            # Chaos / self-healing plane (serving fleet failure model,
+            # docs/ROBUSTNESS.md): quarantines, splice-mismatch heals,
+            # breaker openings, detached pump threads, brownout
+            # transitions + the final ladder level. All 0/None on a
+            # fleet that never needed to heal, which emits none of them.
+            "quarantines": points.get("fleet.quarantine", 0),
+            "splice_mismatches": points.get("fleet.splice_mismatch", 0),
+            "breaker_opens": points.get("fleet.breaker_open", 0),
+            "thread_leaks": points.get("fleet.thread_leaked", 0),
+            "chaos_faults": points.get("chaos.fault_fired", 0),
+            "brownout_steps": points.get("serve.brownout_step", 0),
+            "brownout_shed": counters.get("serve.brownout_shed", 0),
+            "brownout_stage": gauges.get("fleet.brownout_stage"),
         }
 
     for entry in slo_by_obj.values():
@@ -459,6 +472,32 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
                     and srv.get("spec_verify_ms") is not None else ""
                 )
             )
+        # Fleet health line: what the self-healing tier had to do
+        # (chaos drills assert on these; a clean run prints nothing).
+        heals = []
+        if srv.get("chaos_faults"):
+            heals.append(f"{srv['chaos_faults']:.0f} chaos faults fired")
+        if srv.get("quarantines"):
+            heals.append(f"{srv['quarantines']:.0f} quarantine(s)")
+        if srv.get("splice_mismatches"):
+            heals.append(
+                f"{srv['splice_mismatches']:.0f} splice mismatch(es) healed"
+            )
+        if srv.get("breaker_opens"):
+            heals.append(f"{srv['breaker_opens']:.0f} breaker(s) opened")
+        if srv.get("thread_leaks"):
+            heals.append(f"{srv['thread_leaks']:.0f} pump thread(s) detached")
+        if srv.get("brownout_steps"):
+            stage = srv.get("brownout_stage")
+            heals.append(
+                f"{srv['brownout_steps']:.0f} brownout step(s)"
+                + (f" (final stage {stage:.0f})" if stage is not None
+                   else "")
+                + (f", {srv['brownout_shed']:.0f} shed" if srv.get(
+                    "brownout_shed") else "")
+            )
+        if heals:
+            add("  fleet health: " + ", ".join(heals))
         # Per-request latency anatomy: where the time went.
         for label, key in (
             ("queue wait", "queue_wait"), ("ttft", "ttft"),

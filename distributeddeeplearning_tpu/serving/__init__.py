@@ -31,15 +31,32 @@ accelerator between requests and recompile per prompt length. Here:
   token-for-token identical to non-speculative decode; sampled streams
   keep the target's distribution exactly.
 
+* Chaos plane + self-healing fleet (:mod:`~.chaos`,
+  docs/ROBUSTNESS.md serving failure model) — seeded tick-indexed
+  fleet fault verbs (``SERVE_CHAOS_PLAN``:
+  crash/hang/slow/corrupt/flap) drive the router's monitor sweep:
+  heartbeat hard-faults, straggler quarantine with splice-verified
+  hedging, corrupt detect-and-heal, a crash-loop circuit breaker, and
+  the :class:`~.scheduler.BrownoutLadder` degradation stages.
+
 Per-request output is **bitwise-identical** to sequential
 ``inference.generate`` (greedy and seeded sampling) whatever the
 co-scheduling — ``tests/test_serving.py`` is the oracle
-(``tests/test_serving_spec.py`` for the speculative tier).
+(``tests/test_serving_spec.py`` for the speculative tier,
+``tests/test_serving_chaos.py`` for the chaos plane).
 """
 
 from distributeddeeplearning_tpu.serving.blocks import (  # noqa: F401
     BlockAllocator,
     BlockPoolExhausted,
+)
+from distributeddeeplearning_tpu.serving.chaos import (  # noqa: F401
+    ChaosCrash,
+    ChaosInjector,
+    FleetFault,
+    SpliceMismatch,
+    parse_chaos_plan,
+    storm_plan,
 )
 from distributeddeeplearning_tpu.serving.engine import (  # noqa: F401
     ReqSpec,
@@ -60,12 +77,15 @@ from distributeddeeplearning_tpu.serving.spec import (  # noqa: F401
 from distributeddeeplearning_tpu.serving.scheduler import (  # noqa: F401
     AdaptiveAdmissionPolicy,
     AdmissionPolicy,
+    BrownoutLadder,
+    BrownoutStage,
     QueueFull,
     Request,
     RequestHandle,
     Server,
     ServeConfig,
     generate_with_engine,
+    parse_brownout_stages,
 )
 from distributeddeeplearning_tpu.serving.fleet import (  # noqa: F401
     ControllerConfig,

@@ -347,6 +347,14 @@ class SlotEngine:
         self.compile_count = 0
         self.compile_sec = 0.0
         self.decode_steps = 0
+        # Brownout ladder hook (serving/scheduler.py): True routes
+        # ticks through the plain decode program (already compiled —
+        # the program set is unchanged); draft state keeps tracking the
+        # committed stream so resuming speculation stays correct (the
+        # int8 draft's KV falls behind and proposals degrade until the
+        # slot turns over, but the verify commits target tokens either
+        # way — a throughput knob, never a correctness one).
+        self.spec_suspended = False
         # Running speculative tallies (serve_bench's accept-rate
         # percentiles; the serve.spec_* gauges/counters mirror them).
         self.spec_stats: Dict[str, Any] = {
@@ -1144,6 +1152,14 @@ class SlotEngine:
         self.decode_steps += 1
         out = []
         for i in slots:
+            if self.spec_k:
+                # A spec engine stepping plainly (brownout spec_off):
+                # keep the drafter's view of the committed stream
+                # current so resuming speculation proposes from real
+                # history.
+                self._prev_tokens[i] = int(self._tokens[i])
+                if self._history[i] is not None:
+                    self._history[i].append(int(nxt[i]))
             self._tokens[i] = nxt[i]
             self._positions[i] += 1
             self._cursor[i] += 1

@@ -43,6 +43,12 @@ class ControllerConfig:
     low_pressure: float = 0.35
     up_ticks: int = 3            # consecutive hot ticks before scale-up
     down_ticks: int = 8          # consecutive cold ticks before drain
+    # Crash-loop respect (docs/ROBUSTNESS.md serving failure model): a
+    # breaker opening means a replica crash-looped through its whole
+    # restart budget — blindly adding capacity right after would feed
+    # the same failure. Scale-up is held for this many router ticks
+    # after the most recent breaker opening (0 = never hold).
+    breaker_block_ticks: int = 10
 
     def validate(self) -> None:
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -136,6 +142,22 @@ class FleetController:
             self._hot = self._cold = 0
         ready = self._ready_count()
         if self._hot >= cfg.up_ticks and ready < cfg.max_replicas:
+            # Respect open breakers: right after a replica crash-looped
+            # through its restart budget, hold scale-up for a cooldown
+            # window instead of feeding the same failure more capacity.
+            # (The Router's membership door separately refuses a
+            # breaker-open rid forever.)
+            last = self.router.last_breaker_tick
+            if (
+                cfg.breaker_block_ticks
+                and last is not None
+                and self.router._ticks - last < cfg.breaker_block_ticks
+            ):
+                obs.point(
+                    "fleet.scale_up_blocked", pressure=round(p, 4),
+                    breaker_tick=last,
+                )
+                return None
             rid = self.router.next_rid()
             self.router.add_replica(
                 self.factory(rid), start=True,

@@ -25,6 +25,17 @@ properties make N of them composable inside one process:
   supervisor uses. The router re-routes a faulted replica's work; a
   retryable replica may :meth:`rejoin` (rebuilding its engine — a
   faulted pool's device state is not trusted).
+
+The health plane (docs/ROBUSTNESS.md serving failure model) rides the
+pump: every iteration stamps ``heartbeat_t`` (a hung pump goes stale
+and the router hard-faults it), every busy tick feeds the latency EWMA
+the straggler detector compares against the fleet median, and the
+chaos injector (``SERVE_CHAOS_PLAN``) is consulted at the top of every
+tick so fault drills are tick-deterministic. ``stop()`` detaches an
+unjoinable thread instead of leaking it silently
+(``fleet.thread_leaked``), and a pump *generation* counter guarantees
+a detached zombie that later wakes can never pump or drain a rebuilt
+server.
 """
 
 from __future__ import annotations
@@ -111,6 +122,32 @@ class Replica:
         # Set by Router.fail_replica: the pump must NOT gracefully
         # drain on stop — the router is taking the work elsewhere.
         self._abandon = threading.Event()
+        # Health plane (Router._monitor_sweep, docs/ROBUSTNESS.md
+        # serving failure model): the pump stamps heartbeat_t every
+        # iteration it is alive (a hung pump goes stale), and every
+        # busy scheduler tick feeds the latency EWMA the straggler
+        # detector compares against the fleet median.
+        self.heartbeat_t: Optional[float] = None
+        self.tick_ewma: float = 0.0
+        self.tick_samples: int = 0
+        self.straggle_ticks = 0      # consecutive over-factor sightings
+        self.quarantined = False     # drained of placements, on probation
+        self.quarantine_until = 0    # router tick the probation ends at
+        self.leaked_threads = 0      # unjoinable pumps detached by stop()
+        # Chaos plane (serving/chaos.py): the router hands every
+        # replica its injector; the pump consults it per tick.
+        self.chaos = None
+        # Quarantine hedge: the router pauses the pump at a tick
+        # boundary before evicting running work (take_running is only
+        # safe with the pump parked), then resumes it.
+        self._pause = threading.Event()
+        self._pause_ack = threading.Event()
+        self._hang_until = 0.0  # inline pumps' silent-skip window
+        # Pump generation: bumped by every start(). A detached zombie
+        # thread (stop() join timeout) that later wakes compares its
+        # captured generation and exits — it can never pump or drain a
+        # rebuilt server, even after rejoin cleared _stop/_abandon.
+        self._gen = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -122,6 +159,7 @@ class Replica:
             raise RuntimeError(f"replica {self.rid} is {self.state}")
         self.threaded = threaded
         self.state = "starting"
+        self._gen += 1
         self._stop.clear()
         if self.bus is None and self.obs_dir:
             self.bus = obs.EventBus(
@@ -131,7 +169,8 @@ class Replica:
             )
         if threaded:
             self._thread = threading.Thread(
-                target=self._worker, name=f"replica-{self.rid}", daemon=True
+                target=self._worker, args=(self._gen,),
+                name=f"replica-{self.rid}", daemon=True,
             )
             self._thread.start()
         else:
@@ -158,14 +197,75 @@ class Replica:
         )
         obs.point("fleet.replica_ready", replica=self.rid)
 
-    def _worker(self) -> None:
+    def _chaos_gate(self) -> bool:
+        """Consult the chaos injector before a pump tick. Returns False
+        when this tick must be skipped (hang: silent-but-alive, the
+        heartbeat deliberately NOT stamped); raises :class:`ChaosCrash`
+        for crash/flap; sleeps the slow verb's stall inline."""
+        if self.chaos is None:
+            return True
+        action = self.chaos.pump_action(self.rid, time.monotonic())
+        if action is None:
+            return True
+        if action["kind"] == "crash":
+            from distributeddeeplearning_tpu.serving.chaos import ChaosCrash
+
+            raise ChaosCrash(f"chaos crash (replica {self.rid})")
+        if action["kind"] == "hang":
+            if self.threaded:
+                # A genuine wedge: the thread sleeps unjoinably — the
+                # router's heartbeat monitor hard-faults it and stop()
+                # detaches the leaked thread.
+                time.sleep(action["secs"])
+            else:
+                # Inline pumps cannot block the router; silent skip —
+                # heartbeat still goes stale, same detection path.
+                self._hang_until = time.monotonic() + action["secs"]
+            return False
+        if action["kind"] == "slow":
+            time.sleep(action["stall_s"])
+        return True
+
+    def record_tick(self, dur_s: float) -> None:
+        """Feed one busy scheduler-tick latency into the straggler
+        EWMA (alpha 0.3 — reacts within a few ticks, forgets within a
+        probation window)."""
+        self.tick_ewma = (
+            dur_s if self.tick_samples == 0
+            else 0.7 * self.tick_ewma + 0.3 * dur_s
+        )
+        self.tick_samples += 1
+
+    def reset_latency(self) -> None:
+        """Clear the EWMA (leaving quarantine / rejoining): the replica
+        must re-offend with fresh samples to be quarantined again."""
+        self.tick_ewma = 0.0
+        self.tick_samples = 0
+        self.straggle_ticks = 0
+
+    def _worker(self, gen: int) -> None:
         obs.bind_bus(self.bus)
         try:
             self._build()
             if self.state == "starting":  # a drain may already be asked
                 self.state = "ready"
-            while not self._stop.is_set():
-                if not self.server.step():
+            while not self._stop.is_set() and gen == self._gen:
+                self.heartbeat_t = time.monotonic()
+                if self._pause.is_set():
+                    # Parked at a tick boundary for a quarantine hedge:
+                    # alive (heartbeat flows) but not stepping.
+                    self._pause_ack.set()
+                    time.sleep(0.0005)
+                    continue
+                t0 = time.monotonic()
+                if not self._chaos_gate():
+                    continue
+                busy = self.server.step()
+                if busy:
+                    # The tick latency includes any injected stall —
+                    # the straggler detector sees what a client would.
+                    self.record_tick(time.monotonic() - t0)
+                if not busy:
                     if self.state == "draining":
                         break  # empty while draining: done
                     time.sleep(self.idle_sleep_s)
@@ -173,13 +273,16 @@ class Replica:
             # a stopping replica never drops admitted work (the router
             # reclaims *queued* requests before stopping a pump) —
             # unless the router declared this replica failed and is
-            # re-routing everything it holds (_abandon).
-            if not self._abandon.is_set():
+            # re-routing everything it holds (_abandon), or this is a
+            # detached zombie whose replica already restarted (gen).
+            if not self._abandon.is_set() and gen == self._gen:
                 self.server.drain()
                 if self.state in ("draining", "ready", "starting"):
                     self.state = "drained"
                     obs.point("fleet.replica_drained", replica=self.rid)
         except BaseException as e:  # the pump is a thread main: classify
+            if gen != self._gen:
+                return  # detached zombie: the replica already restarted
             self.fault = e
             code = e.code if isinstance(e, SystemExit) and isinstance(
                 getattr(e, "code", None), int
@@ -209,9 +312,20 @@ class Replica:
         path (the router then re-routes its work)."""
         if self.server is None or self.state not in ("ready", "draining"):
             return False
+        now = time.monotonic()
+        if now < self._hang_until:
+            return False  # chaos hang: silent-but-alive, heartbeat stale
         try:
             with obs.bound_bus(self.bus):
+                t0 = time.monotonic()
+                if not self._chaos_gate():
+                    return False
+                self.heartbeat_t = time.monotonic()
                 busy = self.server.step()
+                if busy:
+                    # Tick latency includes any injected stall — the
+                    # straggler detector sees what a client would.
+                    self.record_tick(time.monotonic() - t0)
         except BaseException as e:
             self.fault = e
             self.exit_code = EXIT_HUNG
@@ -236,11 +350,43 @@ class Replica:
             obs.point("fleet.replica_drain", replica=self.rid)
 
     def stop(self, timeout: Optional[float] = 30.0) -> None:
-        """Stop the pump thread (drains admitted work first)."""
+        """Stop the pump thread (drains admitted work first). A pump
+        that does not join within ``timeout`` — a hung thread blocked
+        inside a wedged step or a chaos ``hang`` — is **detached**, not
+        leaked silently: the thread object is dropped (``_abandon`` is
+        already set on the fault path, so if it ever wakes it exits
+        without draining, and a faulted rejoin rebuilds engine+server
+        so the zombie can only touch the abandoned objects), and a
+        ``fleet.thread_leaked`` point records it for drills to assert
+        on."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                self.leaked_threads += 1
+                self._abandon.set()  # a waking zombie must not drain
+                obs.point(
+                    "fleet.thread_leaked", replica=self.rid,
+                    state=self.state,
+                )
             self._thread = None
+
+    def pause(self, timeout: float = 2.0) -> bool:
+        """Park the pump at a tick boundary (quarantine hedge: the
+        router must not evict running slots while a step is in flight).
+        Returns False when the pump never acknowledged — it is hung,
+        and the caller escalates to a hard fault. Inline replicas are
+        trivially paused (the router thread IS the pump)."""
+        if not self.threaded or self._thread is None:
+            return True
+        self._pause_ack.clear()
+        self._pause.set()
+        return self._pause_ack.wait(timeout)
+
+    def resume(self) -> None:
+        self._pause.clear()
+        self._pause_ack.clear()
 
     @property
     def retryable(self) -> bool:
@@ -272,6 +418,12 @@ class Replica:
         self.fault = None
         self.exit_code = None
         self._abandon.clear()
+        self._pause.clear()
+        self._pause_ack.clear()
+        self._hang_until = 0.0
+        self.quarantined = False
+        self.reset_latency()
+        self.heartbeat_t = None
         obs.point("fleet.replica_rejoin", replica=self.rid)
         return self.start(
             threaded=self.threaded if threaded is None else threaded
@@ -281,7 +433,10 @@ class Replica:
 
     @property
     def placeable(self) -> bool:
-        return self.state == "ready" and self.server is not None
+        return (
+            self.state == "ready" and self.server is not None
+            and not self.quarantined
+        )
 
     def free_slot_count(self) -> int:
         if self.engine is None:
@@ -354,6 +509,12 @@ class Replica:
             )
             if self.engine.allocator is not None:
                 out["free_blocks"] = self.engine.allocator.free_count
+        if self.quarantined:
+            out["quarantined"] = True
+        if self.tick_samples:
+            out["tick_ewma_ms"] = round(self.tick_ewma * 1e3, 3)
+        if self.leaked_threads:
+            out["leaked_threads"] = self.leaked_threads
         if self.exit_code is not None:
             out["exit_code"] = self.exit_code
             out["retryable"] = self.retryable

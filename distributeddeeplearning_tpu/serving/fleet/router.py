@@ -31,6 +31,23 @@ between submission and a replica's slot pool:
   where delivery stopped — zero drops, zero duplicates, oracle-tested.
   Rejoin eligibility follows the faults exit taxonomy
   (``faults.classify_exit`` — deterministic failures don't rejoin).
+* **Self-healing monitor** (docs/ROBUSTNESS.md serving failure model)
+  — every tick: stale pump heartbeats hard-fault hung replicas (the
+  unjoinable thread is detached, ``fleet.thread_leaked``); a
+  straggler (busy-tick EWMA > ``SERVE_STRAGGLER_FACTOR`` x the fleet
+  median, sustained) is **quarantined** and its running work hedge
+  re-routed through the splice path; a replay diverging from the
+  delivered prefix (``fleet.splice_mismatch``) hard-faults the
+  divergent replica and heals from the deterministic prefix; faulted
+  replicas auto-rejoin behind a per-replica restart budget with
+  exponential backoff, and budget exhaustion opens a **circuit
+  breaker** (``fleet.breaker_open``) that removes the rid for good.
+  A :class:`~distributeddeeplearning_tpu.serving.scheduler.BrownoutLadder`
+  (``SERVE_BROWNOUT_STAGES``) degrades under sustained SLO burn and
+  walks back on recovery; a seeded
+  :class:`~distributeddeeplearning_tpu.serving.chaos.ChaosInjector`
+  (``SERVE_CHAOS_PLAN``) makes every one of these paths a
+  deterministic drill (``scripts/chaos_bench.py``).
 * **Streaming** — tokens flow to :class:`FleetHandle` the moment a
   replica commits them (``Request.on_token`` push), so ``stream()`` /
   client callbacks see a true incremental stream and TTFT is a real
@@ -45,7 +62,12 @@ between submission and a replica's slot pool:
 Env contract (:meth:`FleetConfig.from_env`, docs/ORCHESTRATION.md):
 ``SERVE_REPLICAS``, ``SERVE_TENANT_WEIGHTS`` (``name:weight,…``),
 ``SERVE_PLACEMENT`` (``affinity`` | ``load`` | ``rr``),
-``SERVE_FLEET_QUEUE_DEPTH``, ``SERVE_FLEET_QUANTUM``.
+``SERVE_FLEET_QUEUE_DEPTH``, ``SERVE_FLEET_QUANTUM``; health/chaos:
+``SERVE_STRAGGLER_FACTOR``, ``SERVE_STRAGGLER_TICKS``,
+``SERVE_QUARANTINE_TICKS``, ``SERVE_PUMP_HEARTBEAT_S``,
+``SERVE_REPLICA_MAX_RESTARTS``, ``SERVE_REPLICA_RESTART_BACKOFF``,
+``SERVE_FAULT_JOIN_S``, ``SERVE_BROWNOUT_STAGES``,
+``SERVE_CHAOS_PLAN``, ``SERVE_CHAOS_SEED``.
 """
 
 from __future__ import annotations
@@ -61,6 +83,7 @@ from typing import Any, Deque, Dict, List, Optional
 import numpy as np
 
 from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.serving.chaos import SpliceMismatch
 from distributeddeeplearning_tpu.serving.fleet.replica import Replica
 from distributeddeeplearning_tpu.serving.scheduler import (
     QueueFull,
@@ -88,6 +111,30 @@ class FleetConfig:
     # — it banks every visit and dispatches once its deficit covers one
     # request.
     quantum: int = 16
+    # Health plane (docs/ROBUSTNESS.md serving failure model): a
+    # replica whose busy-tick latency EWMA exceeds straggler_factor x
+    # the fleet median for straggler_ticks consecutive monitor sweeps
+    # is quarantined (drained of placements, running work hedge
+    # re-routed through the splice path) for quarantine_ticks router
+    # ticks; a threaded pump whose heartbeat goes stale past
+    # heartbeat_timeout_s while it holds work is hard-faulted.
+    straggler_factor: float = 4.0
+    straggler_ticks: int = 5
+    quarantine_ticks: int = 50
+    heartbeat_timeout_s: float = 5.0
+    # Crash-loop circuit breaker (launch_supervised semantics): a
+    # faulted retryable replica auto-rejoins after restart_backoff_s x
+    # 2^attempt; after max_restarts rejoins the breaker opens
+    # (fleet.breaker_open) and the replica is removed. fault_join_s
+    # bounds how long fail/remove wait for a pump before detaching it.
+    max_restarts: int = 3
+    restart_backoff_s: float = 1.0
+    fault_join_s: float = 5.0
+    # Brownout degradation ladder (SERVE_BROWNOUT_STAGES, e.g.
+    # "spec_off,max_new:8,shed:1") and the chaos plane's drill plan.
+    brownout_stages: str = ""
+    chaos_plan: str = ""
+    chaos_seed: int = 0
 
     @classmethod
     def from_env(cls, env=None) -> "FleetConfig":
@@ -103,6 +150,28 @@ class FleetConfig:
                 e.get("SERVE_FLEET_QUEUE_DEPTH", cls.queue_depth)
             ),
             quantum=int(e.get("SERVE_FLEET_QUANTUM", cls.quantum)),
+            straggler_factor=float(
+                e.get("SERVE_STRAGGLER_FACTOR", cls.straggler_factor)
+            ),
+            straggler_ticks=int(
+                e.get("SERVE_STRAGGLER_TICKS", cls.straggler_ticks)
+            ),
+            quarantine_ticks=int(
+                e.get("SERVE_QUARANTINE_TICKS", cls.quarantine_ticks)
+            ),
+            heartbeat_timeout_s=float(
+                e.get("SERVE_PUMP_HEARTBEAT_S", cls.heartbeat_timeout_s)
+            ),
+            max_restarts=int(
+                e.get("SERVE_REPLICA_MAX_RESTARTS", cls.max_restarts)
+            ),
+            restart_backoff_s=float(
+                e.get("SERVE_REPLICA_RESTART_BACKOFF", cls.restart_backoff_s)
+            ),
+            fault_join_s=float(e.get("SERVE_FAULT_JOIN_S", cls.fault_join_s)),
+            brownout_stages=str(e.get("SERVE_BROWNOUT_STAGES", "")),
+            chaos_plan=str(e.get("SERVE_CHAOS_PLAN", "")),
+            chaos_seed=int(e.get("SERVE_CHAOS_SEED", "0")),
         )
 
     def validate(self) -> None:
@@ -118,6 +187,31 @@ class FleetConfig:
         for t, w in (self.tenant_weights or {}).items():
             if w <= 0:
                 raise ValueError(f"tenant {t!r} weight must be > 0, got {w}")
+        if self.straggler_factor <= 1.0:
+            raise ValueError(
+                f"SERVE_STRAGGLER_FACTOR must be > 1, got "
+                f"{self.straggler_factor}"
+            )
+        if self.straggler_ticks < 1 or self.quarantine_ticks < 1:
+            raise ValueError(
+                "straggler_ticks and quarantine_ticks must be >= 1"
+            )
+        if self.max_restarts < 0 or self.restart_backoff_s < 0:
+            raise ValueError(
+                "max_restarts and restart_backoff_s must be >= 0"
+            )
+        if self.brownout_stages:
+            from distributeddeeplearning_tpu.serving.scheduler import (
+                parse_brownout_stages,
+            )
+
+            parse_brownout_stages(self.brownout_stages)
+        if self.chaos_plan:
+            from distributeddeeplearning_tpu.serving.chaos import (
+                parse_chaos_plan,
+            )
+
+            parse_chaos_plan(self.chaos_plan)
 
 
 def parse_tenant_weights(text: str) -> Dict[str, float]:
@@ -175,6 +269,16 @@ class FleetHandle:
         self.replica_id: Optional[int] = None
         self.attempts = 0
         self.restart_consistent = True
+        # Splice-integrity ledger (docs/ROBUSTNESS.md serving failure
+        # model): every replay mismatch ever seen (the corrupt
+        # detector's count — survives healing), the live divergence
+        # flag the router's monitor sweep heals, and the per-attempt
+        # taint that stops a divergent attempt's tokens from ever
+        # reaching the client.
+        self.splice_mismatches = 0
+        self._divergent = False
+        self._sub_tainted = False
+        self._chaos = None  # set by the router when a drill is armed
         self._cond = threading.Condition()
         self._cancel = False
         self._client_cb = request.on_token
@@ -206,14 +310,24 @@ class FleetHandle:
     def stream(self, timeout: Optional[float] = None):
         """Incremental token iterator across attempts — yields each
         token exactly once, in order, whatever re-routing happened
-        underneath (``RequestHandle.stream`` semantics otherwise)."""
+        underneath (``RequestHandle.stream`` semantics otherwise).
+
+        **Timeout contract:** ``timeout`` bounds the wait for EACH next
+        token. On expiry the stream **cancels the request and raises
+        TimeoutError** — the handle detaches from its replica attempt
+        and the fleet reaps it as ``cancelled``, so an abandoned stream
+        never leaves a zombie request decoding (chaos drills submit
+        thousands of bounded streams; without cancel-on-timeout every
+        straggler-stalled stream would leak its slot)."""
         i = 0
         while True:
             with self._cond:
                 while i >= len(self.new_tokens) and not self.done.is_set():
                     if not self._cond.wait(timeout):
+                        self.cancel()
                         raise TimeoutError(
-                            f"request {self.id}: no token within {timeout}s"
+                            f"request {self.id}: no token within "
+                            f"{timeout}s (request cancelled)"
                         )
                 fresh = self.new_tokens[i:]
             for tok in fresh:
@@ -230,6 +344,7 @@ class FleetHandle:
     def _attach(self, sub: RequestHandle, replica_id: int) -> None:
         self._sub = sub
         self._sub_seen = 0
+        self._sub_tainted = False
         self.replica_id = replica_id
         self.attempts += 1
         self.status = "running"
@@ -237,23 +352,43 @@ class FleetHandle:
     def _detach(self) -> None:
         self._sub = None
         self._sub_seen = 0
+        self._sub_tainted = False
         self.replica_id = None
         self.status = "queued"
 
     def _ingest(self, toks: List[int]) -> None:
         """Splice one attempt's delivery into the fleet stream. Called
-        from the replica's serving thread (via ``Request.on_token``)."""
+        from the replica's serving thread (via ``Request.on_token``).
+
+        Replayed tokens (an attempt re-covering the already-delivered
+        prefix after a re-route) are verified token-for-token against
+        the delivered stream and never re-emitted. A mismatch —
+        determinism says a healthy replica cannot produce one, so the
+        attempt is emitting corrupt data — **taints the whole attempt**:
+        nothing further from it reaches the client, and the router's
+        monitor sweep hard-faults the replica and replays the stream
+        from the deterministic prefix elsewhere (the corrupt verb's
+        detect-and-heal path, docs/ROBUSTNESS.md)."""
+        mismatch = False
         fresh: List[int] = []
         with self._cond:
+            if self._sub_tainted:
+                return
             start = self._sub_seen
             self._sub_seen += len(toks)
             for j, tok in enumerate(toks):
                 gi = start + j
                 if gi < len(self.new_tokens):
-                    # Replay of an already-delivered prefix (post-fault
-                    # restart): determinism says it must match.
-                    if self.new_tokens[gi] != int(tok):
+                    t_in = int(tok)
+                    if self._chaos is not None:
+                        t_in = self._chaos.maybe_corrupt(self.id, t_in)
+                    if self.new_tokens[gi] != t_in:
                         self.restart_consistent = False
+                        self.splice_mismatches += 1
+                        self._divergent = True
+                        self._sub_tainted = True
+                        mismatch = True
+                        break  # drop the attempt's remaining tokens
                 else:
                     self.new_tokens.append(int(tok))
                     fresh.append(int(tok))
@@ -261,7 +396,7 @@ class FleetHandle:
                 self.ttft_s = time.monotonic() - self.submitted_t
             if fresh:
                 self._cond.notify_all()
-        if not self.restart_consistent:
+        if mismatch:
             obs.point("fleet.restart_divergence", req=self.id)
         if fresh and self._client_cb is not None:
             try:
@@ -295,6 +430,8 @@ class Router:
         replicas: Optional[List[Replica]] = None,
         *,
         config: Optional[FleetConfig] = None,
+        chaos=None,
+        brownout=None,
     ) -> None:
         self.config = config or FleetConfig()
         self.config.validate()
@@ -310,9 +447,44 @@ class Router:
         self._drr_fresh = True
         self._closed = False
         self.last_pressure = 0.0
+        # Chaos plane + brownout ladder (env-wired by default; tests
+        # and benches inject their own).
+        if chaos is None and self.config.chaos_plan:
+            from distributeddeeplearning_tpu.serving.chaos import (
+                ChaosInjector,
+                parse_chaos_plan,
+            )
+
+            chaos = ChaosInjector(
+                parse_chaos_plan(self.config.chaos_plan),
+                seed=self.config.chaos_seed,
+            )
+        self.chaos = chaos
+        if brownout is None and self.config.brownout_stages:
+            from distributeddeeplearning_tpu.serving.scheduler import (
+                BrownoutLadder,
+                parse_brownout_stages,
+            )
+
+            brownout = BrownoutLadder(
+                parse_brownout_stages(self.config.brownout_stages)
+            )
+        self.brownout = brownout
+        self._ticks = 0  # completed router ticks (the chaos clock)
+        # Crash-loop breaker ledger: rid -> {attempts, next_t, pending,
+        # open}. Survives a replica's removal so a breaker-open rid can
+        # never slip back into rotation.
+        self._breakers: Dict[int, Dict[str, Any]] = {}
+        self.last_breaker_tick: Optional[int] = None
+        # Brownout state applied by apply_brownout_stage.
+        self._shed_tenants: set = set()
+        self._shed_by_stage: Dict[int, set] = {}
+        self._brownout_max_new: Optional[int] = None
         self.stats: Dict[str, Any] = {
             "submitted": 0, "dispatched": 0, "requeued": 0, "completed": 0,
             "rejected": 0, "cancelled": 0, "deadline": 0,
+            "quarantined": 0, "unquarantined": 0, "splice_mismatch": 0,
+            "breaker_open": 0, "rejoins": 0, "brownout": 0,
         }
         for r in replicas or []:
             self.add_replica(r, start=False)
@@ -321,7 +493,16 @@ class Router:
 
     def add_replica(self, replica: Replica, *, start: bool = True,
                     threaded: bool = True) -> Replica:
-        """Register (and by default start) one replica."""
+        """Register (and by default start) one replica. A rid whose
+        circuit breaker is open is refused — a crash-looping replica
+        does not slip back in through the membership door."""
+        b = self._breakers.get(replica.rid)
+        if b is not None and b.get("open"):
+            raise RuntimeError(
+                f"replica {replica.rid} breaker is open "
+                f"(restart budget exhausted)"
+            )
+        replica.chaos = self.chaos
         self.replicas.append(replica)
         obs.point("fleet.replica_add", replica=replica.rid)
         if start and replica.state == "new":
@@ -335,8 +516,11 @@ class Router:
         raise KeyError(f"no replica {rid}")
 
     def next_rid(self) -> int:
-        """A fresh replica id (controller scale-up)."""
-        return max((r.rid for r in self.replicas), default=-1) + 1
+        """A fresh replica id (controller scale-up). The breaker
+        ledger counts as used — a breaker-open rid is never re-minted
+        for a new replica."""
+        used = [r.rid for r in self.replicas] + list(self._breakers)
+        return max(used, default=-1) + 1
 
     def drain_replica(self, rid: int) -> int:
         """Graceful drain: stop placing onto ``rid``, pull its queued
@@ -349,11 +533,20 @@ class Router:
 
     def fail_replica(self, rid: int, error: Optional[BaseException] = None
                      ) -> int:
-        """Treat ``rid`` as faulted NOW (health probe / operator):
-        stop its pump and re-route queued AND running requests."""
+        """Treat ``rid`` as faulted NOW (health probe / operator /
+        heartbeat monitor): stop its pump and re-route queued AND
+        running requests. Double-fault-safe: a second call on an
+        already-faulted replica only re-sweeps leftover work (it never
+        re-stops, re-classifies, or double-requeues). A pump that will
+        not join (hung thread) is *detached* by ``Replica.stop`` — a
+        ``fleet.thread_leaked`` point, never a silent zombie still
+        mutating the server (the rejoin path rebuilds engine+server, so
+        a waking zombie can only touch the abandoned objects)."""
         replica = self._replica(rid)
+        already = replica.state == "faulted" and replica._abandon.is_set()
         replica._abandon.set()  # do not drain: we re-route instead
-        replica.stop(timeout=5.0)
+        if not already:
+            replica.stop(timeout=self.config.fault_join_s)
         if replica.state != "faulted":
             replica.state = "faulted"
             replica.fault = error
@@ -366,6 +559,31 @@ class Router:
                 exit_code=replica.exit_code, retryable=True,
             )
         return self._requeue_from(replica, running_too=True)
+
+    def quarantine_replica(self, rid: int, **labels: Any) -> int:
+        """Straggler quarantine: stop placing onto ``rid`` and hedge
+        re-route its queued AND running requests through the splice
+        path — the replica stays alive (still pumping, on probation for
+        ``quarantine_ticks`` router ticks) so a transient stall heals
+        without a rebuild. The pump is paused at a tick boundary before
+        running slots are evicted (``take_running`` is only safe with
+        the pump parked); a pump that never acknowledges the pause is
+        hung, and the monitor escalates to :meth:`fail_replica`."""
+        replica = self._replica(rid)
+        if replica.quarantined:
+            return 0
+        if not replica.pause(timeout=self.config.fault_join_s):
+            return self.fail_replica(
+                rid, TimeoutError("pump unresponsive to quarantine pause")
+            )
+        replica.quarantined = True
+        replica.quarantine_until = self._ticks + self.config.quarantine_ticks
+        replica.straggle_ticks = 0
+        self.stats["quarantined"] += 1
+        obs.point("fleet.quarantine", replica=rid, **labels)
+        moved = self._requeue_from(replica, running_too=True)
+        replica.resume()
+        return moved
 
     def remove_replica(self, rid: int) -> Replica:
         """Take a drained/faulted replica out of the fleet (its queued
@@ -383,7 +601,7 @@ class Router:
             raise RuntimeError(
                 f"replica {rid} still holds un-rerouted requests"
             )
-        replica.stop(timeout=5.0)
+        replica.stop(timeout=self.config.fault_join_s)
         replica.state = "removed"
         self.replicas = [r for r in self.replicas if r.rid != rid]
         obs.point("fleet.replica_remove", replica=rid)
@@ -392,15 +610,64 @@ class Router:
     def rejoin_replica(self, replica_or_rid, *, threaded: Optional[bool]
                        = None) -> Replica:
         """Bring a drained/faulted/removed replica back into rotation
-        (``Replica.rejoin`` rules: non-retryable faults refuse)."""
+        (``Replica.rejoin`` rules: non-retryable faults refuse).
+
+        Every post-fault rejoin burns the replica's restart budget
+        (``SERVE_REPLICA_MAX_RESTARTS``, launch_supervised semantics):
+        budget exhausted or breaker already open → refused. An
+        already-ready replica (the monitor's auto-heal beat a manual
+        call) is returned unchanged."""
         replica = (
             replica_or_rid if isinstance(replica_or_rid, Replica)
             else self._replica(replica_or_rid)
         )
+        b = self._breakers.get(replica.rid)
+        if b is not None and b["open"]:
+            raise RuntimeError(
+                f"replica {replica.rid} breaker is open "
+                f"(restart budget exhausted)"
+            )
+        if replica.state in ("ready", "starting", "draining"):
+            return replica  # auto-heal already brought it back
+        if replica.state == "faulted":
+            b = self._breaker(replica.rid)
+            if b["attempts"] >= self.config.max_restarts:
+                self._open_breaker(replica, b)
+                raise RuntimeError(
+                    f"replica {replica.rid} restart budget exhausted "
+                    f"({b['attempts']}/{self.config.max_restarts}); "
+                    f"breaker opened"
+                )
+            b["attempts"] += 1
+            b["pending"] = False
+            self.stats["rejoins"] += 1
         replica.rejoin(threaded=threaded)
         if replica not in self.replicas:
             self.replicas.append(replica)
         return replica
+
+    def _breaker(self, rid: int) -> Dict[str, Any]:
+        return self._breakers.setdefault(
+            rid, {"attempts": 0, "next_t": 0.0, "pending": False,
+                  "open": False},
+        )
+
+    def _open_breaker(self, replica: Replica, b: Dict[str, Any]) -> None:
+        """Budget exhausted (or non-retryable fault): open the circuit,
+        re-route whatever the replica still holds, take it out of the
+        fleet. The breaker ledger outlives the removal, so the rid can
+        never slip back in (``add_replica``/``rejoin_replica`` refuse)."""
+        b["open"] = True
+        self.last_breaker_tick = self._ticks
+        self.stats["breaker_open"] += 1
+        obs.point(
+            "fleet.breaker_open", replica=replica.rid,
+            attempts=b["attempts"], retryable=replica.retryable,
+            exit_code=replica.exit_code,
+        )
+        self._requeue_from(replica, running_too=True)
+        if any(r.rid == replica.rid for r in self.replicas):
+            self.remove_replica(replica.rid)
 
     def _requeue_from(self, replica: Replica, *, running_too: bool) -> int:
         """Reclaim a replica's requests and put them back at the front
@@ -447,11 +714,20 @@ class Router:
         malformed request fails the caller, not the dispatch loop."""
         if self._closed:
             raise RuntimeError("router is closed")
+        now = time.monotonic()
+        if tenant in self._shed_tenants:
+            # Brownout shed: a distinct, client-visible outcome — the
+            # handle finishes as "brownout" immediately, never a silent
+            # drop and never a generic QueueFull masquerade.
+            fh = FleetHandle(request, tenant, next(self._ids), now)
+            self.stats["brownout"] += 1
+            obs.counter("serve.brownout_shed", tenant=tenant)
+            fh._finish("brownout")
+            return fh
         for r in self.replicas:
             if r.placeable:
                 r.engine.validate_spec(request.spec())
                 break
-        now = time.monotonic()
         with self._lock:
             backlog = sum(len(t.queue) for t in self._tenants.values())
             if backlog >= self.config.queue_depth:
@@ -469,11 +745,19 @@ class Router:
     # -- pump --------------------------------------------------------------
 
     def step(self, now: Optional[float] = None) -> bool:
-        """One router tick: health sweep → finish sweep → DRR dispatch
-        → inline replica pumps → fleet gauges. Returns True while work
-        remains anywhere in the fleet."""
+        """One router tick: chaos clock → monitor sweep (heartbeat,
+        stragglers, splice integrity, breaker auto-heal) → health sweep
+        → brownout ladder → finish sweep → DRR dispatch → inline
+        replica pumps → fleet gauges. Returns True while work remains
+        anywhere in the fleet."""
         now = time.monotonic() if now is None else now
+        self._ticks += 1
+        if self.chaos is not None:
+            self._chaos_tick(now)
+        self._monitor_sweep(now)
         self._health_sweep()
+        if self.brownout is not None:
+            self.brownout.tick(self, now)
         self._finish_sweep()
         self._dispatch(now)
         busy = False
@@ -486,6 +770,147 @@ class Router:
             inflight = len(self._inflight)
         self._emit_gauges(backlog, inflight)
         return bool(backlog or inflight or busy)
+
+    def _chaos_tick(self, now: float) -> None:
+        """Activate the drill directives due at this tick: pump verbs
+        arm on their replica; ``corrupt`` picks its victim (the
+        lowest-id running handle with a delivered prefix — the replay
+        window the flip must land in), arms the one-shot flip, and
+        hedge re-routes the victim's replica so the replay happens."""
+        for f in self.chaos.due(self._ticks):
+            if f.kind == "corrupt":
+                with self._lock:
+                    running = sorted(
+                        (
+                            fh for fh in self._inflight
+                            if fh.new_tokens and fh.replica_id is not None
+                        ),
+                        key=lambda fh: (fh.replica_id != f.replica, fh.id),
+                    )
+                if not running:
+                    # nothing replayable yet: re-queue the directive for
+                    # the next tick rather than dropping the drill verb
+                    # (victims on the named replica are preferred; any
+                    # running handle with a delivered prefix will do —
+                    # the flip rides the handle, not the replica).
+                    self.chaos.defer(f)
+                    continue
+                fh = running[0]
+                fh._chaos = self.chaos
+                self.chaos.arm_corrupt(f, fh.id)
+                self.quarantine_replica(
+                    fh.replica_id, reason="chaos_corrupt_hedge"
+                )
+            else:
+                self.chaos.arm_pump(f, now)
+
+    def _monitor_sweep(self, now: float) -> None:
+        """The health monitor (docs/ROBUSTNESS.md serving failure
+        model): four checks, all tick-deterministic.
+
+        1. **Heartbeat** — a threaded pump whose heartbeat is stale past
+           ``heartbeat_timeout_s`` while the replica holds work is hung
+           (alive-but-silent): hard-fault, re-route, detach the thread.
+        2. **Stragglers** — a replica whose busy-tick EWMA exceeds
+           ``straggler_factor`` x the fleet median for
+           ``straggler_ticks`` consecutive sweeps is quarantined; the
+           probation expires after ``quarantine_ticks`` router ticks.
+        3. **Splice integrity** — a handle whose replay diverged from
+           its delivered prefix hard-faults the divergent replica and
+           replays from the deterministic prefix (the corrupt
+           detect-and-heal path).
+        4. **Breaker auto-heal** — faulted retryable replicas rejoin
+           after ``restart_backoff_s x 2^attempt``; budget exhausted or
+           non-retryable → breaker opens, replica removed.
+        """
+        cfg = self.config
+        for r in list(self.replicas):
+            if (
+                r.threaded and r.state in ("ready", "draining")
+                and r.server is not None and r.heartbeat_t is not None
+                and now - r.heartbeat_t > cfg.heartbeat_timeout_s
+                and (r.server.active_count or r.server.queued_count)
+            ):
+                self.fail_replica(
+                    r.rid,
+                    TimeoutError(
+                        f"pump heartbeat stale "
+                        f"{now - r.heartbeat_t:.2f}s"
+                    ),
+                )
+        sampled = [
+            r for r in self.replicas
+            if r.state == "ready" and r.tick_samples >= 3
+        ]
+        if len(sampled) >= 2:
+            ewmas = sorted(r.tick_ewma for r in sampled)
+            median = ewmas[(len(ewmas) - 1) // 2]
+            for r in sampled:
+                if r.quarantined:
+                    continue
+                if median > 0 and r.tick_ewma > cfg.straggler_factor * median:
+                    r.straggle_ticks += 1
+                    if r.straggle_ticks >= cfg.straggler_ticks:
+                        self.quarantine_replica(
+                            r.rid,
+                            ewma_ms=round(r.tick_ewma * 1e3, 3),
+                            median_ms=round(median * 1e3, 3),
+                        )
+                else:
+                    r.straggle_ticks = 0
+        for r in self.replicas:
+            if r.quarantined and self._ticks >= r.quarantine_until:
+                r.quarantined = False
+                r.reset_latency()
+                self.stats["unquarantined"] += 1
+                obs.point("fleet.unquarantine", replica=r.rid)
+        with self._lock:
+            divergent = [fh for fh in self._inflight if fh._divergent]
+        for fh in divergent:
+            rid = fh.replica_id
+            self.stats["splice_mismatch"] += 1
+            obs.point("fleet.splice_mismatch", req=fh.id, replica=rid)
+            # The delivered prefix is immutable (already streamed); the
+            # divergent attempt is the corrupt one. Heal: hard-fault
+            # the replica producing it and replay from the prefix.
+            fh._divergent = False
+            fh.restart_consistent = True
+            if rid is not None and any(r.rid == rid for r in self.replicas):
+                self.fail_replica(
+                    rid, SpliceMismatch(f"request {fh.id} replay diverged")
+                )
+            elif fh._sub is not None:
+                # replica already gone: just re-queue the handle itself
+                with self._lock:
+                    if fh in self._inflight:
+                        self._inflight.remove(fh)
+                        fh._detach()
+                        self._tenant(fh.tenant).queue.appendleft(fh)
+                        self.stats["requeued"] += 1
+        for r in list(self.replicas):
+            if r.state != "faulted":
+                continue
+            b = self._breaker(r.rid)
+            if b["open"]:
+                continue
+            if not r.retryable or b["attempts"] >= cfg.max_restarts:
+                self._open_breaker(r, b)
+                continue
+            if not b["pending"]:
+                b["pending"] = True
+                b["next_t"] = now + cfg.restart_backoff_s * (
+                    2 ** b["attempts"]
+                )
+                obs.point(
+                    "fleet.rejoin_scheduled", replica=r.rid,
+                    attempt=b["attempts"] + 1,
+                    backoff_s=round(b["next_t"] - now, 3),
+                )
+            elif now >= b["next_t"]:
+                try:
+                    self.rejoin_replica(r.rid)
+                except RuntimeError:
+                    pass  # breaker opened (budget raced) — ledger has it
 
     def _health_sweep(self) -> None:
         for r in list(self.replicas):
@@ -502,6 +927,11 @@ class Router:
         for fh in inflight:
             sub = fh._sub
             if sub is None:
+                continue
+            if fh._divergent:
+                # splice mismatch pending: the monitor sweep re-routes
+                # this handle — finishing it now would deliver a stream
+                # cut at the divergence point.
                 continue
             if sub.status == "requeued":
                 # reclaim raced us (drain path) — the requeue already
@@ -643,8 +1073,16 @@ class Router:
         return max(candidates, key=score)
 
     def _dispatch_to(self, replica: Replica, fh: FleetHandle) -> None:
+        max_new = fh.request.max_new_tokens
+        if self._brownout_max_new is not None:
+            # Brownout cap applies at dispatch (new placements only —
+            # running streams keep their budget). Replays of a capped
+            # request use the same cap via the unchanged fh.request, so
+            # the splice contract is unaffected.
+            max_new = min(max_new, self._brownout_max_new)
         req = dataclasses.replace(
             fh.request,
+            max_new_tokens=max_new,
             on_token=lambda _h, toks, fh=fh: fh._ingest(toks),
             # fleet-level deadline already tracked on the FleetHandle;
             # the remaining budget rides to the replica so running
@@ -661,6 +1099,64 @@ class Router:
         self.stats["dispatched"] += 1
         obs.counter("fleet.dispatched", tenant=fh.tenant,
                     replica=replica.rid)
+
+    # -- brownout ladder actions (scheduler.BrownoutLadder drives) ---------
+
+    def apply_brownout_stage(self, stage, on: bool, key: int = 0) -> None:
+        """Apply (``on=True``) or revert one declared degradation stage
+        (docs/ROBUSTNESS.md degradation ladder):
+
+        * ``spec_off`` — suspend speculative decode on every replica
+          engine (the plain decode program is already in the closed
+          set, so this compiles nothing);
+        * ``max_new:N`` — cap ``max_new_tokens`` for newly dispatched
+          requests at N;
+        * ``shed:K`` — shed the K lowest-weight tenant lanes: queued
+          and arriving requests finish with the distinct ``brownout``
+          outcome, never silently dropped.
+
+        ``key`` identifies the stage instance so revert releases
+        exactly what this stage shed."""
+        if stage.kind == "spec_off":
+            for r in self.replicas:
+                if r.engine is not None:
+                    r.engine.spec_suspended = on
+        elif stage.kind == "max_new":
+            self._brownout_max_new = int(stage.value) if on else None
+        elif stage.kind == "shed":
+            if on:
+                with self._lock:
+                    ranked = sorted(
+                        (
+                            t for t in self._tenants.values()
+                            if t.name not in self._shed_tenants
+                        ),
+                        key=lambda t: (t.weight, t.name),
+                    )
+                shed = {t.name for t in ranked[: int(stage.value)]}
+                self._shed_by_stage[key] = shed
+                self._shed_tenants |= shed
+                for name in shed:
+                    self._flush_shed_lane(name)
+            else:
+                self._shed_tenants -= self._shed_by_stage.pop(key, set())
+        else:
+            raise ValueError(f"unknown brownout stage {stage.kind!r}")
+
+    def _flush_shed_lane(self, tenant: str) -> None:
+        """Finish every queued request of a newly shed lane with the
+        ``brownout`` outcome (running streams are never interrupted —
+        shedding relieves *future* load)."""
+        with self._lock:
+            t = self._tenants.get(tenant)
+            victims = list(t.queue) if t is not None else []
+            if t is not None:
+                t.queue.clear()
+                t.deficit = 0.0
+        for fh in victims:
+            self.stats["brownout"] += 1
+            obs.counter("serve.brownout_shed", tenant=tenant)
+            fh._finish("brownout")
 
     # -- autoscale signal --------------------------------------------------
 
@@ -698,6 +1194,20 @@ class Router:
         )
         obs.gauge("serve.fleet_queued", float(backlog))
         obs.gauge("serve.fleet_active", float(inflight))
+        # Health-plane gauges (docs/OBSERVABILITY.md; obs_watch renders
+        # them as the fleet-health row).
+        obs.gauge(
+            "fleet.quarantined",
+            float(sum(1 for r in self.replicas if r.quarantined)),
+        )
+        obs.gauge(
+            "fleet.breaker_open",
+            float(sum(1 for b in self._breakers.values() if b["open"])),
+        )
+        obs.gauge(
+            "fleet.brownout_stage",
+            float(self.brownout.level) if self.brownout is not None else 0.0,
+        )
 
     # -- lifecycle ---------------------------------------------------------
 

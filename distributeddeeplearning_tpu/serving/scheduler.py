@@ -66,6 +66,32 @@ class QueueFull(RuntimeError):
 # Admission policies (telemetry feedback — docs/SERVING.md)
 # ---------------------------------------------------------------------------
 
+def burning_latency_objectives(
+    snapshot: Optional[dict], watch_prefix: Optional[str] = None
+) -> List[str]:
+    """The *latency* objectives currently burning in a rollup snapshot
+    — a latency objective is one whose stat is a span quantile
+    (p50/p95/p99); rate/gauge objectives describe throughput or health
+    and shedding load would not help them. Shared by
+    :class:`AdaptiveAdmissionPolicy` (derate) and
+    :class:`BrownoutLadder` (the degradation ladder that engages when
+    derating alone does not recover)."""
+    if not snapshot:
+        return []
+    out = []
+    for st in snapshot.get("slo") or []:
+        if not st.get("burning"):
+            continue
+        if st.get("stat") not in ("p50", "p95", "p99"):
+            continue
+        if watch_prefix and not str(st.get("metric", "")).startswith(
+            watch_prefix
+        ):
+            continue
+        out.append(st.get("objective", "?"))
+    return out
+
+
 class AdmissionPolicy:
     """Hook run at the top of every scheduler tick.
 
@@ -130,20 +156,7 @@ class AdaptiveAdmissionPolicy(AdmissionPolicy):
 
     def burning_latency(self, snapshot: Optional[dict]) -> List[str]:
         """The burning latency objectives this policy acts on."""
-        if not snapshot:
-            return []
-        out = []
-        for st in snapshot.get("slo") or []:
-            if not st.get("burning"):
-                continue
-            if st.get("stat") not in ("p50", "p95", "p99"):
-                continue
-            if self.watch_prefix and not str(st.get("metric", "")).startswith(
-                self.watch_prefix
-            ):
-                continue
-            out.append(st.get("objective", "?"))
-        return out
+        return burning_latency_objectives(snapshot, self.watch_prefix)
 
     def tick(self, server: "Server", now: float) -> None:
         if now < self._next_read:
@@ -188,6 +201,167 @@ class AdaptiveAdmissionPolicy(AdmissionPolicy):
             "serve.admission_prefills", float(server.prefills_per_step)
         )
         obs.gauge("serve.admission_queue_limit", float(server.queue_limit))
+
+
+# ---------------------------------------------------------------------------
+# Brownout degradation ladder (docs/ROBUSTNESS.md serving failure model)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BrownoutStage:
+    """One declared degradation stage: ``spec_off`` (suspend the
+    speculative tier — the plain decode program is already compiled),
+    ``max_new`` (cap newly dispatched requests at ``value`` tokens), or
+    ``shed`` (shed the ``value`` lowest-weight tenant lanes with the
+    distinct ``brownout`` outcome)."""
+
+    kind: str
+    value: int = 0
+
+
+def parse_brownout_stages(text: str) -> List[BrownoutStage]:
+    """``SERVE_BROWNOUT_STAGES`` grammar: comma-separated stages, e.g.
+    ``"spec_off,max_new:8,shed:1"`` — the order IS the ladder (stage k
+    applies at brownout level k+1; recovery reverts in reverse)."""
+    stages: List[BrownoutStage] = []
+    for part in str(text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, val = part.partition(":")
+        kind = kind.strip()
+        if kind == "spec_off":
+            if val.strip():
+                raise ValueError(
+                    f"brownout stage {part!r}: spec_off takes no value"
+                )
+            stages.append(BrownoutStage("spec_off"))
+        elif kind in ("max_new", "shed"):
+            try:
+                v = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"brownout stage {part!r}: {kind} needs an int value "
+                    f"({kind}:N)"
+                )
+            if v < 1:
+                raise ValueError(
+                    f"brownout stage {part!r}: value must be >= 1"
+                )
+            stages.append(BrownoutStage(kind, v))
+        else:
+            raise ValueError(
+                f"unknown brownout stage {kind!r} in {part!r} "
+                f"(have: spec_off, max_new:N, shed:K)"
+            )
+    if not stages:
+        raise ValueError("SERVE_BROWNOUT_STAGES declared no stages")
+    return stages
+
+
+class BrownoutLadder:
+    """Step through declared degradation stages under sustained SLO
+    burn; walk back up on recovery.
+
+    :class:`AdaptiveAdmissionPolicy` is the first responder — it
+    derates admission the moment a latency SLO burns. This ladder is
+    the escalation tier: when the burn *persists* (``escalate_ticks``
+    consecutive burning observations — i.e. the derate did not
+    recover), it applies the next declared stage via
+    ``Router.apply_brownout_stage``; when the burn clears for
+    ``recover_ticks`` consecutive observations it reverts one stage, in
+    reverse order. Every transition is an obs point
+    (``serve.brownout_step``) and the level a gauge
+    (``fleet.brownout_stage``) — degradation is telemetry, never a
+    silent drop.
+
+    Signal sources mirror the admission policy: an injected ``reader``
+    (tests, chaos drills), else the live plane's ``rollup.json``.
+    """
+
+    def __init__(
+        self,
+        stages: List[BrownoutStage],
+        *,
+        snapshot_path: Optional[str] = None,
+        reader=None,
+        refresh_s: float = 0.25,
+        escalate_ticks: int = 8,
+        recover_ticks: int = 12,
+        watch_prefix: Optional[str] = None,
+    ) -> None:
+        if not stages:
+            raise ValueError("BrownoutLadder needs at least one stage")
+        if snapshot_path is None:
+            snapshot_path = os.path.join(
+                os.environ.get("OBS_DIR", "."), "rollup.json"
+            )
+        self.stages = list(stages)
+        self.snapshot_path = snapshot_path
+        self._reader = reader
+        self.refresh_s = max(float(refresh_s), 0.0)
+        self.escalate_ticks = max(int(escalate_ticks), 1)
+        self.recover_ticks = max(int(recover_ticks), 1)
+        self.watch_prefix = watch_prefix
+        self.level = 0  # stages[:level] are currently applied
+        self._hot = 0
+        self._cool = 0
+        self._next_read = 0.0
+        self.transitions: List[Dict[str, Any]] = []
+
+    def _read(self) -> Optional[dict]:
+        if self._reader is not None:
+            return self._reader()
+        from distributeddeeplearning_tpu.obs.rollup import read_snapshot
+
+        return read_snapshot(self.snapshot_path)
+
+    def tick(self, router, now: float) -> Optional[str]:
+        """One ladder decision (the router calls this every tick).
+        Returns ``"down"`` (degraded one stage), ``"up"`` (recovered
+        one), or None."""
+        if now < self._next_read:
+            return None
+        self._next_read = now + self.refresh_s
+        snap = self._read()
+        if snap is None:
+            return None  # no plane publishing: hold the current level
+        burning = burning_latency_objectives(snap, self.watch_prefix)
+        if burning:
+            self._hot += 1
+            self._cool = 0
+        else:
+            self._cool += 1
+            self._hot = 0
+        if (
+            burning and self._hot >= self.escalate_ticks
+            and self.level < len(self.stages)
+        ):
+            stage = self.stages[self.level]
+            self.level += 1
+            self._hot = 0
+            router.apply_brownout_stage(stage, True, key=self.level)
+            self._record("down", stage, objectives=";".join(burning))
+            return "down"
+        if not burning and self._cool >= self.recover_ticks and self.level:
+            stage = self.stages[self.level - 1]
+            router.apply_brownout_stage(stage, False, key=self.level)
+            self.level -= 1
+            self._cool = 0
+            self._record("up", stage)
+            return "up"
+        return None
+
+    def _record(self, direction: str, stage: BrownoutStage, **labels) -> None:
+        self.transitions.append({
+            "direction": direction, "level": self.level,
+            "stage": stage.kind, **labels,
+        })
+        obs.point(
+            "serve.brownout_step", direction=direction, level=self.level,
+            stage=stage.kind, value=stage.value, **labels,
+        )
+        obs.gauge("fleet.brownout_stage", float(self.level))
 
 
 @dataclasses.dataclass
@@ -637,7 +811,12 @@ class Server:
                 # Speculative tier: one tick commits 1..spec_k+1 tokens
                 # per slot (draft + batched verify); the non-spec step
                 # is the single-token special case of the same shape.
-                if self.engine.spec_enabled:
+                # A brownout spec_off stage suspends speculation at
+                # runtime — the plain decode program is already in the
+                # closed set, so the fallback compiles nothing.
+                if self.engine.spec_enabled and not getattr(
+                    self.engine, "spec_suspended", False
+                ):
                     emitted = self.engine.spec_step()
                 else:
                     emitted = [

@@ -15,10 +15,12 @@ with no tier), cross-platform pairs, pairs whose
 baseline, not a regression; records predating the quantized tier count
 as the native "bf16" config), pairs whose ``spec_k`` changed (a
 re-speculated protocol likewise — records predating the speculative
-tier count as ``spec_k=0``), and pairs whose ``data_format`` changed
+tier count as ``spec_k=0``), pairs whose ``data_format`` changed
 (synthetic pool vs streamed shards is a different input pipeline —
 ``data_change`` skip; records predating the streamed tier count as the
-native synthetic reader).
+native synthetic reader), and pairs whose ``chaos_plan`` differs (a
+fault storm is part of the protocol — ``chaos_change`` skip;
+chaos-free records normalize to no plan).
 
 A drop > ``--threshold`` (default 10%) between *consecutive comparable*
 records of the same metric+platform exits nonzero — the CI tripwire
@@ -133,6 +135,12 @@ def analyze(
             # same way (aggregate throughput over N pools is a new
             # baseline); non-fleet records normalize to 1 replica.
             "replicas": int(detail.get("replicas") or 1),
+            # A chaos plan's presence (or a different storm) re-shapes
+            # the whole run — faults, rebuilds and brownout windows are
+            # part of the protocol, not noise around it — so any
+            # chaos-plan difference is a protocol skip, never a
+            # regression. Chaos-free records normalize to "".
+            "chaos": str(detail.get("chaos_plan") or ""),
             # An elastic world resize is the training-side analog: the
             # same metric over a different device count is a new
             # baseline (``world_change`` skip). Pre-elastic records
@@ -158,6 +166,7 @@ def analyze(
                 and prev["replicas"] == row["replicas"]
                 and prev["world"] == row["world"]
                 and prev["data_format"] == row["data_format"]
+                and prev["chaos"] == row["chaos"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -193,6 +202,11 @@ def analyze(
                     f"data_change:{prev['data_format']}"
                     f"->{row['data_format']}"
                 )
+            elif prev is not None and prev["chaos"] != row["chaos"]:
+                row["skip"] = (
+                    f"chaos_change:"
+                    f"{prev['chaos'] or 'none'}->{row['chaos'] or 'none'}"
+                )
             elif prev is not None:
                 row["skip"] = (
                     f"world_change:{prev['world'] or 'unspecified'}"
@@ -209,6 +223,7 @@ def analyze(
                     "spec_k": row["spec_k"], "replicas": row["replicas"],
                     "world": row["world"],
                     "data_format": row["data_format"],
+                    "chaos": row["chaos"],
                 }
         rows.append(row)
     return {

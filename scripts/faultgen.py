@@ -18,13 +18,24 @@ injector) and ``docs/ROBUSTNESS.md`` (failure model). Three actions:
   preemption at ``--step`` losing ``--ranks`` processes, with capacity
   restored either ``--restore-secs`` later (wall clock) or once the
   shrunken world completes ``--restore-step`` (deterministic drills).
+* ``chaos-drill`` — emit a canned seeded serving-fleet storm
+  (``SERVE_CHAOS_PLAN``, ``serving/chaos.py``): one directive per
+  ``--verbs`` entry over ``--replicas`` replicas, ticks drawn from
+  ``--storm-seed`` — the plan ``scripts/chaos_bench.py`` replays.
+
+``validate`` speaks BOTH dialects: a plan whose directives carry
+``tick=`` (or use the fleet verbs crash/slow/corrupt/flap) validates
+against the serving chaos grammar; everything else against the
+training ``FAULT_PLAN`` grammar.
 
 Usage::
 
     python scripts/faultgen.py validate "kill:step=3,rank=1;nan:step=2"
+    python scripts/faultgen.py validate "crash:tick=4,replica=0;slow:tick=6,replica=1,factor=6"
     python scripts/faultgen.py corrupt-latest /path/to/model_dir
     python scripts/faultgen.py exit-codes
     python scripts/faultgen.py elastic-drill --step 3 --restore-step 6
+    python scripts/faultgen.py chaos-drill --replicas 2 --storm-seed 7
 """
 
 import argparse
@@ -36,9 +47,57 @@ sys.path.insert(
 )
 
 from distributeddeeplearning_tpu import faults  # noqa: E402
+from distributeddeeplearning_tpu.serving import chaos  # noqa: E402
+
+
+def _is_fleet_plan(text: str) -> bool:
+    """Dialect sniff: fleet directives are tick-indexed (``tick=``) or
+    use a verb only the fleet grammar knows (``hang`` is shared — its
+    keys disambiguate)."""
+    fleet_only = set(chaos.FLEET_FAULT_KINDS) - set(faults.FAULT_KINDS)
+    for raw in (text or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind = raw.partition(":")[0].strip()
+        if kind in fleet_only or "tick=" in raw.replace(" ", ""):
+            return True
+    return False
+
+
+def _print_fleet_plan(plan) -> None:
+    print(f"{len(plan)} fleet fault(s) (serving chaos plane):")
+    for f in plan:
+        detail = ""
+        if f.kind == "hang":
+            detail = f" for {f.secs:g}s (heartbeat goes stale)"
+        elif f.kind == "slow":
+            detail = (
+                f" (+{f.factor:g}x{chaos.SLOW_UNIT_S * 1e3:g}ms per pump "
+                f"for {f.secs:g}s — straggler bait)"
+            )
+        elif f.kind == "corrupt":
+            detail = " (replay-token flip; splice verifier must catch it)"
+        elif f.kind == "flap":
+            detail = f" x{f.count} crash->rejoin cycles (breaker bait)"
+        print(
+            f"  {f.kind:<7s} replica {f.replica} after router tick "
+            f"{f.tick}{detail}"
+        )
 
 
 def _cmd_validate(args) -> int:
+    if _is_fleet_plan(args.plan):
+        try:
+            plan = chaos.parse_chaos_plan(args.plan)
+        except ValueError as e:
+            print(f"invalid SERVE_CHAOS_PLAN: {e}", file=sys.stderr)
+            return 2
+        if not plan:
+            print("empty plan (no faults)")
+            return 0
+        _print_fleet_plan(plan)
+        return 0
     try:
         plan = faults.parse_fault_plan(args.plan)
     except ValueError as e:
@@ -115,6 +174,31 @@ def _cmd_elastic_drill(args) -> int:
     return 0
 
 
+def _cmd_chaos_drill(args) -> int:
+    """Emit (and validate) a canned seeded serving-fleet storm."""
+    verbs = tuple(
+        v.strip() for v in args.verbs.split(",") if v.strip()
+    )
+    try:
+        plan = chaos.storm_plan(
+            args.replicas, seed=args.storm_seed, verbs=verbs,
+        )
+    except ValueError as e:
+        print(f"invalid drill spec: {e}", file=sys.stderr)
+        return 2
+    print(plan)
+    if args.verbose:
+        print(
+            "# replay the storm through the gated bench, e.g.:\n"
+            f"#   SERVE_CHAOS_PLAN='{plan}' \\\n"
+            f"#       SERVE_REPLICAS={args.replicas} "
+            f"SERVE_CHAOS_SEED={args.storm_seed} \\\n"
+            "#       python scripts/chaos_bench.py",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def _cmd_exit_codes(args) -> int:
     rows = [
         faults.classify_exit(rc)
@@ -142,7 +226,11 @@ def main(argv=None) -> int:
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    v = sub.add_parser("validate", help="parse + pretty-print a FAULT_PLAN")
+    v = sub.add_parser(
+        "validate",
+        help="parse + pretty-print a FAULT_PLAN or SERVE_CHAOS_PLAN "
+        "(dialect auto-detected)",
+    )
     v.add_argument("plan")
     v.set_defaults(fn=_cmd_validate)
 
@@ -183,6 +271,30 @@ def main(argv=None) -> int:
         help="also print the launch.py invocation recipe to stderr",
     )
     d.set_defaults(fn=_cmd_elastic_drill)
+
+    k = sub.add_parser(
+        "chaos-drill",
+        help="emit a canned seeded serving-fleet storm "
+        "(SERVE_CHAOS_PLAN; scripts/chaos_bench.py)",
+    )
+    k.add_argument(
+        "--replicas", type=int, default=2,
+        help="fleet size the storm targets (default 2)",
+    )
+    k.add_argument(
+        "--storm-seed", type=int, default=0,
+        help="seed drawing the directive ticks/targets (default 0)",
+    )
+    k.add_argument(
+        "--verbs", default=",".join(chaos.FLEET_FAULT_KINDS),
+        help="comma-separated fleet verbs to include "
+        f"(default: {','.join(chaos.FLEET_FAULT_KINDS)})",
+    )
+    k.add_argument(
+        "--verbose", action="store_true",
+        help="also print the chaos_bench invocation recipe to stderr",
+    )
+    k.set_defaults(fn=_cmd_chaos_drill)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -158,6 +158,22 @@ def render(snapshot: dict) -> str:
                 f"{name:32s} {_fmt_val(g['value']):>12s} "
                 f"{age if age is not None else '?':>8}"
             )
+    # Fleet health row (chaos/self-healing tier, docs/ROBUSTNESS.md):
+    # rendered whenever the router publishes the health gauges.
+    health = []
+    for label, name in (
+        ("quarantined", "fleet.quarantined"),
+        ("breakers open", "fleet.breaker_open"),
+        ("brownout stage", "fleet.brownout_stage"),
+    ):
+        cell = (gauges or {}).get(name)
+        if cell is not None and cell.get("value") is not None:
+            health.append((label, cell["value"]))
+    if health and any(v for _, v in health):
+        add("")
+        add("fleet health: " + "  ".join(
+            f"{label} {v:.0f}" for label, v in health
+        ))
     if replicas:
         add("")
         add("serving replicas (one row per events-*-s<k> stream):")

@@ -29,6 +29,11 @@ Protocols (all via bench.py's existing modes — no new measurement code):
                     replicas, multi-tenant closed
                     backlog: scaling + flat TTFT +
                     weighted fairness + bitwise parity
+    serve_lm_chaos  chaos_bench seeded mixed-verb      tokens/sec
+                    fault storm (crash/hang/slow/
+                    corrupt/flap) + brownout ladder:
+                    splice parity, corrupt healed,
+                    breaker budget, bounded TTFT
     lm_stream       stream_bench pretrain-on-shards    tokens/sec
                     (streamed reader, cursor manifest)
                     -> restore -> SlotEngine greedy
@@ -149,6 +154,27 @@ PROTOCOLS = {
         "SERVE_REQUESTS": "48", "SERVE_MAX_NEW": "16",
         "SERVE_RATE_RPS": "0", "SERVE_BUCKETS": "8,16",
     },
+    # Serving chaos plane (docs/ROBUSTNESS.md serving failure model):
+    # one seeded mixed-verb fault storm (crash+hang+slow+corrupt+flap,
+    # chaos.storm_plan) over a closed 3-tenant backlog on 2 replicas,
+    # with the brownout ladder driven through a deterministic burn
+    # window — the row's JSON line carries the undisturbed and storm
+    # runs, the fired-fault ledger and every gate verdict, and the
+    # script exits non-zero unless every non-shed request completes
+    # with BITWISE splice parity, the corrupt injection is detected
+    # and healed (never delivered), the flap opens the breaker inside
+    # its declared budget, program sets stay closed (rebuilds
+    # itemized), p99 TTFT holds within the declared multiple, and the
+    # brownout ladder steps down AND back up.
+    "serve_lm_chaos": {
+        "_script": "scripts/chaos_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_REPLICAS": "2", "SERVE_SLOTS": "4",
+        "SERVE_TENANT_WEIGHTS": "gold:3,silver:2,bronze:1",
+        "SERVE_REQUESTS": "36", "SERVE_MAX_NEW": "16",
+        "SERVE_RATE_RPS": "0", "SERVE_BUCKETS": "8,16",
+        "SERVE_CHAOS_SEED": "0",
+    },
     # Streamed data plane + the first pretrain->serve artifact
     # (docs/DATA.md): pretrain lm_tiny on seeded token shards through
     # the stream reader (checkpointable shuffle cursor + host prefetch),
@@ -190,6 +216,14 @@ _PROTOCOL_VARS = (
     "SERVE_FLEET_QUEUE_DEPTH", "SERVE_FLEET_QUANTUM",
     "SERVE_FLEET_MIN_SCALING", "SERVE_FLEET_SINGLE_CORE_MIN",
     "SERVE_FLEET_TTFT_MAX_RATIO", "SERVE_FLEET_FAIRNESS_TOL",
+    # Chaos plane + self-healing knobs (serve_lm_chaos row,
+    # docs/ROBUSTNESS.md): a leaked SERVE_CHAOS_PLAN must never storm
+    # the other serving rows.
+    "SERVE_CHAOS_PLAN", "SERVE_CHAOS_SEED", "SERVE_CHAOS_TTFT_MAX_RATIO",
+    "SERVE_STRAGGLER_FACTOR", "SERVE_STRAGGLER_TICKS",
+    "SERVE_QUARANTINE_TICKS", "SERVE_PUMP_HEARTBEAT_S",
+    "SERVE_REPLICA_MAX_RESTARTS", "SERVE_REPLICA_RESTART_BACKOFF",
+    "SERVE_FAULT_JOIN_S", "SERVE_BROWNOUT_STAGES",
     # Streamed data plane (lm_stream row + the DATA_* data-factory
     # knobs, docs/DATA.md): joined here so an exported DATA_FORMAT or
     # stream geometry can never leak into rows that leave it unset.
