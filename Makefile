@@ -22,6 +22,7 @@ JOB               ?= ddl-train
 PY                ?= python
 
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
+        lint \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
         obs-watch bench-trend accum-memory fault-suite elastic-drill \
         serve-bench serve-bench-spec fleet-bench chaos-bench stream-shards \
@@ -64,9 +65,19 @@ test:	## full suite (~52 min on a 1-vCPU host; see docs/TESTING.md)
 test-fast:	## deselect the measured-heavy oracles (tests/heavy_tests.txt)
 	$(PY) -m pytest tests/ -x -q -m "not heavy"
 
-check:	## CI gate: heavy-list drift guard, then the fast tier — a new
-	## slow test that skipped tests/heavy_tests.txt fails here instead
-	## of silently bloating every fast run (scripts/heavy_refresh.py)
+lint:	## ddlint static-analysis suite (docs/ANALYSIS.md): AST host-sync/
+	## tracer lint over the hot paths, HLO donation/collective/cache-key
+	## audit of every engine step + the SlotEngine program set, and the
+	## env/obs/protocol contract cross-checks. Writes lint.json. Single
+	## rule: $(PY) scripts/ddlint.py --rule <name> (--list for the
+	## catalogue)
+	$(PY) scripts/ddlint.py
+
+check:	## CI gate: heavy-list drift guard + the ddlint suite (one
+	## command — heavy_refresh --check chains ddlint --changed-ok),
+	## then the fast tier — a new slow test that skipped
+	## tests/heavy_tests.txt fails here instead of silently bloating
+	## every fast run (scripts/heavy_refresh.py)
 	$(PY) scripts/heavy_refresh.py --check
 	$(MAKE) test-fast
 

@@ -1,0 +1,208 @@
+"""ddlint — repo-invariant static analysis (docs/ANALYSIS.md).
+
+The codebase's hardest-won properties — ≤1 host sync per epoch, closed
+program sets, donated state buffers, the ORCHESTRATION.md env contract,
+the OBSERVABILITY.md event registry, recertify's ``_PROTOCOL_VARS``
+scrub list — were all enforced *dynamically*: an oracle has to re-run
+(and has to happen to build the right config) before a regression is
+even visible. This package is the static tier: three analyzer families
+that check the whole class at lint time, on every config at once.
+
+* :mod:`.ast_sync` — AST pass over the compiled-step code paths
+  flagging implicit host syncs and tracer leaks (``float()/int()/
+  bool()/.item()/np.asarray`` or truthiness on values traced from
+  jnp/jax/lax), with the one allowlist anchored on
+  ``utils/hostsync.device_get`` call sites.
+* :mod:`.hlo_audit` — lowers each engine's step plus the SlotEngine
+  program set on a CPU mesh and walks the compiled module: donation
+  actually aliased, collectives where the design says they are (none
+  inside the ACCUM_STEPS scan body), byte-identical HLO across two
+  lowers of the same config (cache-key stability).
+* :mod:`.contracts` — cross-checkers diffing every ``os.environ`` read
+  against the docs' env tables, every ``obs``/``bus`` emit name
+  against the OBSERVABILITY.md registry, and every SERVE_*/STREAM_*/
+  BENCH_*/DATA_* config knob against recertify's ``_PROTOCOL_VARS``.
+
+Suppression grammar (counted, never silent) — the marker names a rule
+(or ``*``) and must carry a reason::
+
+    tokens = np.asarray(out)  # ddlint: ok(host-sync): tick boundary
+
+A reasonless or unparseable marker is itself a finding (rule
+``bad-suppression``).
+
+Entry point: ``scripts/ddlint.py`` / ``make lint`` (gated by
+``make check`` via ``heavy_refresh.py --check``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PACKAGE_ROOT = os.path.join(REPO_ROOT, "distributeddeeplearning_tpu")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding, anchored to a file:line."""
+
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None  # the suppression's reason, when suppressed
+
+    def format(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+# ``# ddlint: ok(rule): reason`` — rule may be ``*`` (any rule on that
+# line); the reason is mandatory (an unexplained suppression rots).
+_SUPPRESS_RE = re.compile(
+    r"#\s*ddlint:\s*ok\(\s*(?P<rule>[\w*\-]+)\s*\)\s*(?::\s*(?P<reason>.*\S))?"
+)
+
+
+def parse_suppressions(
+    source: str,
+) -> Tuple[Dict[int, List[Tuple[str, str]]], List[Tuple[int, str]]]:
+    """Scan source for suppression markers.
+
+    Returns ``(by_line, malformed)``: ``by_line[lineno]`` is the list of
+    ``(rule, reason)`` markers on that line; ``malformed`` lists
+    ``(lineno, problem)`` for reasonless markers (these become
+    ``bad-suppression`` findings — a suppression must say why).
+    """
+    by_line: Dict[int, List[Tuple[str, str]]] = {}
+    malformed: List[Tuple[int, str]] = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        if "ddlint" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            if re.search(r"#\s*ddlint", text):
+                malformed.append(
+                    (i, "unparseable ddlint marker — write it as "
+                        "'ddlint: ok(<rule>): <reason>' in a comment")
+                )
+            continue
+        reason = m.group("reason")
+        if not reason:
+            malformed.append(
+                (i, f"suppression of {m.group('rule')!r} carries no reason")
+            )
+            continue
+        by_line.setdefault(i, []).append((m.group("rule"), reason))
+    return by_line, malformed
+
+
+def apply_suppressions(
+    findings: List[Finding], sources: Dict[str, str]
+) -> List[Finding]:
+    """Mark findings whose line carries a matching ``ok(...)`` marker as
+    suppressed, and append ``bad-suppression`` findings for reasonless
+    markers. ``sources`` maps repo-relative path → file text."""
+    out: List[Finding] = []
+    parsed = {
+        path: parse_suppressions(src) for path, src in sources.items()
+    }
+    for f in findings:
+        by_line, _ = parsed.get(f.path, ({}, []))
+        # A marker binds to its own line, or up to two lines above it —
+        # the tail of a wrapped statement (the finding anchors at the
+        # statement's first line; the comment fits on its last).
+        markers = [
+            m for off in (0, 1, 2) for m in by_line.get(f.line + off, [])
+        ]
+        for rule, reason in markers:
+            if rule in ("*", f.rule):
+                f.suppressed = True
+                f.reason = reason
+                break
+        out.append(f)
+    for path, (_, malformed) in parsed.items():
+        for lineno, problem in malformed:
+            out.append(
+                Finding("bad-suppression", path, lineno, problem)
+            )
+    return out
+
+
+def repo_rel(path: str) -> str:
+    return os.path.relpath(os.path.abspath(path), REPO_ROOT)
+
+
+def package_sources(
+    roots: Optional[List[str]] = None,
+) -> Dict[str, str]:
+    """Repo-relative path → source text for every ``.py`` under the
+    given roots (default: the package + scripts + bench.py)."""
+    if roots is None:
+        roots = [
+            PACKAGE_ROOT,
+            os.path.join(REPO_ROOT, "scripts"),
+            os.path.join(REPO_ROOT, "bench.py"),
+        ]
+    out: Dict[str, str] = {}
+    for root in roots:
+        if os.path.isfile(root):
+            paths = [root]
+        else:
+            paths = [
+                os.path.join(dirpath, name)
+                for dirpath, dirnames, names in os.walk(root)
+                for name in names
+                if name.endswith(".py") and "__pycache__" not in dirpath
+            ]
+        for p in sorted(paths):
+            with open(p, encoding="utf-8") as fh:
+                out[repo_rel(p)] = fh.read()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+# name -> (family, description, runner). Runners take no arguments and
+# return raw (unsuppressed) findings against the repo at HEAD; the CLI
+# applies suppressions over the scanned sources afterwards.
+RuleRunner = Callable[[], List[Finding]]
+_REGISTRY: Dict[str, Tuple[str, str, RuleRunner]] = {}
+
+
+def register(name: str, family: str, description: str):
+    def deco(fn: RuleRunner) -> RuleRunner:
+        _REGISTRY[name] = (family, description, fn)
+        return fn
+
+    return deco
+
+
+def rules(family: Optional[str] = None) -> Dict[str, Tuple[str, str, RuleRunner]]:
+    """The registered rules (import side effect: loads all families).
+
+    The HLO family imports jax lazily inside its runners, so listing
+    rules stays instant."""
+    from distributeddeeplearning_tpu.analysis import (  # noqa: F401
+        ast_sync,
+        contracts,
+        hlo_audit,
+    )
+
+    if family is None:
+        return dict(_REGISTRY)
+    return {
+        n: meta for n, meta in _REGISTRY.items() if meta[0] == family
+    }
+
+
+FAMILIES = ("ast", "hlo", "contract")

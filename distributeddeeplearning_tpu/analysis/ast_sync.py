@@ -1,0 +1,334 @@
+"""AST lint for implicit host syncs and tracer leaks in hot paths.
+
+The sync-free loop's dynamic oracle (``tests/test_sync_free_loop.py``)
+counts materialisations at runtime — it only sees the code paths the
+test happens to drive. This pass reads the *source* of every
+compiled-step code path and flags, at any config:
+
+* ``host-sync`` — materialising a value produced by jnp/jax/lax in the
+  same scope (``float()/int()/bool()``, ``np.asarray``/``np.array``,
+  ``.item()``), raw ``jax.device_get``, and ``.block_until_ready()``.
+  Every repo-internal materialisation must route through
+  ``utils/hostsync.device_get`` (the accountant books it and the run
+  report shows the call site) — that call is the ONE allowlist.
+* ``tracer-bool`` — truthiness tests on traced values (``if x:``,
+  ``while x:``, ``assert x``, ``not x``, ``x and y``): under jit these
+  either raise a ConcretizationTypeError at trace time or, in host-side
+  glue, silently force a device sync per step.
+
+Taint model (deliberately simple, per function scope with lexical
+nesting): a name is *traced* when assigned from a call rooted at
+``jnp``/``jax``/``lax`` (or from arithmetic/comparison/indexing on a
+traced value); ``.shape``/``.ndim``/``.dtype``/``.size``/``len()``
+launder the taint (host metadata); ``hostsync.device_get(x)`` is the
+accounted materialisation and both consumes and clears taint. Values
+returned by compiled executables (``self._decode_exec(...)``) are NOT
+tainted — the serving tick's deliberate token materialisation is the
+engine's contract, and the dynamic accountant still covers it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from distributeddeeplearning_tpu.analysis import (
+    Finding,
+    PACKAGE_ROOT,
+    register,
+    repo_rel,
+)
+
+# The compiled-step code paths (ISSUE 14): every file whose functions
+# are traced into an XLA program, or sit on the per-step/per-tick hot
+# path around one. Keep sorted; adding a file here is how a new hot
+# path opts into the lint.
+HOT_PATHS = (
+    "models/transformer_lm.py",
+    "models/vit.py",
+    "ops/attention.py",
+    "serving/engine.py",
+    "serving/sampling.py",
+    "training/accum.py",
+    "training/pjit_step.py",
+    "training/pp_step.py",
+    "training/sp_step.py",
+    "training/train_step.py",
+)
+
+_TRACED_ROOTS = {"jnp", "lax"}
+# jax.* calls that return host-side (or host-safe) values — not taint
+# sources. jax.device_get is handled as an explicit sink instead.
+_JAX_HOST_ATTRS = {
+    "device_count", "process_count", "process_index", "local_device_count",
+    "devices", "local_devices", "default_backend", "tree_structure",
+    # jax.tree / tree_util container ops return host lists/structures
+    # (of possibly-traced leaves — the list itself is host data, and its
+    # truthiness/len is legitimate host logic).
+    "leaves", "tree_leaves", "flatten", "tree_flatten", "structure",
+    "unflatten", "tree_unflatten", "keystr", "leaves_with_path",
+    "tree_leaves_with_path", "tree_flatten_with_path",
+}
+# jnp.* functions returning host metadata, not arrays.
+_JNP_HOST_FUNCS = {
+    "ndim", "shape", "size", "result_type", "issubdtype", "isdtype",
+    "dtype", "iinfo", "finfo",
+}
+_DETAINT_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_CAST_SINKS = {"float", "int", "bool", "complex"}
+_NP_SINKS = {"asarray", "array", "float32", "float64", "int32", "int64"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` → "a.b.c" for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Scope:
+    def __init__(self, parent: Optional["_Scope"] = None) -> None:
+        self.parent = parent
+        self.tainted: Set[str] = set()
+
+    def is_tainted(self, name: str) -> bool:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if name in s.tainted:
+                return True
+            s = s.parent
+        return False
+
+
+class _SyncLinter(ast.NodeVisitor):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.findings: List[Finding] = []
+        self.scope = _Scope()
+
+    # -- taint -------------------------------------------------------------
+
+    def _tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return self.scope.is_tainted(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _DETAINT_ATTRS:
+                return False
+            return self._tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_taints(node)
+        if isinstance(node, ast.BinOp):
+            return self._tainted(node.left) or self._tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self._tainted(node.left) or any(
+                self._tainted(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._tainted(node.body) or self._tainted(node.orelse)
+        return False
+
+    def _call_taints(self, node: ast.Call) -> bool:
+        """Does this call produce a traced value?"""
+        name = _dotted(node.func)
+        if name is None:
+            return False
+        root = name.split(".", 1)[0]
+        if root in _TRACED_ROOTS:
+            return name.split(".")[-1] not in _JNP_HOST_FUNCS
+        if root == "jax":
+            attr = name.split(".")[-1]
+            if name == "jax.device_get" or attr in _JAX_HOST_ATTRS:
+                return False
+            return True
+        # hostsync.device_get returns a host value.
+        if name.endswith("device_get"):
+            return False
+        # Method calls on traced receivers stay traced (.astype, .sum,
+        # .reshape ... — .item() is a sink, caught before we get here).
+        if isinstance(node.func, ast.Attribute):
+            return self._tainted(node.func.value)
+        return False
+
+    # -- sinks -------------------------------------------------------------
+
+    def _flag(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path, getattr(node, "lineno", 0), message)
+        )
+
+    def _check_truthiness(self, test: ast.AST, context: str) -> None:
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                self._check_truthiness(v, context)
+            return
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self._check_truthiness(test.operand, context)
+            return
+        # A Compare on traced values yields a traced bool array — its
+        # truthiness is the leak; plain tainted names likewise.
+        if self._tainted(test):
+            self._flag(
+                test, "tracer-bool",
+                f"truthiness test on a traced value in {context} — under "
+                f"jit this is a ConcretizationTypeError (or a silent host "
+                f"sync per step); reduce on device (jnp.any/jnp.all) and "
+                f"materialise once via utils/hostsync.device_get",
+            )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        # Raw jax.device_get: the unaccounted materialisation — the one
+        # allowlisted spelling is utils/hostsync.device_get.
+        if name == "jax.device_get":
+            self._flag(
+                node, "host-sync",
+                "raw jax.device_get — route through utils/hostsync."
+                "device_get so the materialisation is booked with the "
+                "sync accountant (the ≤1-sync/epoch ledger)",
+            )
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == (
+            "block_until_ready"
+        ):
+            self._flag(
+                node, "host-sync",
+                ".block_until_ready() stalls the dispatch queue — hot "
+                "paths must stay async (time at the epoch boundary "
+                "instead)",
+            )
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            if self._tainted(node.func.value):
+                self._flag(
+                    node, "host-sync",
+                    ".item() on a traced value — a device→host sync; "
+                    "use utils/hostsync.device_get at the boundary",
+                )
+        elif name in _CAST_SINKS and node.args:
+            if self._tainted(node.args[0]):
+                self._flag(
+                    node, "host-sync",
+                    f"{name}() on a traced value materialises it — keep "
+                    f"the math on device, or hostsync.device_get at the "
+                    f"epoch/tick boundary",
+                )
+        elif (
+            name is not None
+            and name.split(".", 1)[0] in ("np", "numpy")
+            and name.split(".")[-1] in _NP_SINKS
+            and node.args
+            and self._tainted(node.args[0])
+        ):
+            self._flag(
+                node, "host-sync",
+                f"{name}() on a traced value is an implicit device_get — "
+                f"route through utils/hostsync.device_get",
+            )
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_truthiness(node.test, "an if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_truthiness(node.test, "a while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_truthiness(node.test, "an assert")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_truthiness(node.test, "a conditional expression")
+        self.generic_visit(node)
+
+    # -- assignment taint propagation -------------------------------------
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.scope.tainted.add(target.id)
+            else:
+                self.scope.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        # Attribute/Subscript targets: no name-level taint to track.
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        tainted = self._tainted(node.value)
+        for t in node.targets:
+            self._bind(t, tainted)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self._tainted(node.value):
+            self._bind(node.target, True)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None:
+            self._bind(node.target, self._tainted(node.value))
+
+    def _visit_function(self, node) -> None:
+        self.scope = _Scope(self.scope)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope = self.scope.parent
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.scope = _Scope(self.scope)
+        self.visit(node.body)
+        self.scope = self.scope.parent
+
+
+def lint_source(source: str, path: str) -> List[Finding]:
+    """Run the sync/tracer lint over one file's source text."""
+    tree = ast.parse(source, filename=path)
+    linter = _SyncLinter(path)
+    linter.visit(tree)
+    return linter.findings
+
+
+def _run(rule: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in HOT_PATHS:
+        path = os.path.join(PACKAGE_ROOT, rel)
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(
+            f for f in lint_source(src, repo_rel(path)) if f.rule == rule
+        )
+    return findings
+
+
+@register(
+    "host-sync", "ast",
+    "implicit device→host materialisations in compiled-step code paths "
+    "(float/int/bool/.item/np.asarray on traced values, raw "
+    "jax.device_get, block_until_ready)",
+)
+def run_host_sync() -> List[Finding]:
+    return _run("host-sync")
+
+
+@register(
+    "tracer-bool", "ast",
+    "truthiness tests on traced values in compiled-step code paths",
+)
+def run_tracer_bool() -> List[Finding]:
+    return _run("tracer-bool")

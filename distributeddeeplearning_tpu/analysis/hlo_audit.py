@@ -1,0 +1,521 @@
+"""HLO-level audit: donation, collective placement, cache-key stability.
+
+The dynamic oracles sample these properties on whatever configs a test
+happens to build; this family lowers the real programs — each training
+engine's compiled step (dp / pjit / sp / pp at tiny-LM scale) plus the
+SlotEngine's closed program set (via :meth:`SlotEngine.program_specs`,
+the same table warmup compiles) — on the forced-8-CPU-device mesh and
+walks the compiled modules:
+
+* ``hlo-donation`` — every donated input leaf (the state under
+  ``donate_argnums=(0,)``, the KV pool under ``(1,)``) must actually be
+  reclaimed by a call: the compiled program runs once and each donated
+  device buffer ≥ 4 KiB must come back ``is_deleted()``. A donation
+  that silently fails doubles the state's HBM footprint; XLA only
+  warns.
+* ``hlo-collectives`` — the dp step carries its gradient all-reduce;
+  the ACCUM_STEPS variant carries NO collective inside the scan body
+  (``while``-loop computations, transitively) and exactly as many
+  all-reduces as the plain step — collectives run once per dispatch on
+  the accumulated means, never once per microbatch.
+* ``hlo-cache-key`` — building + lowering the same config twice must
+  produce byte-identical HLO text. Nondeterministic lowering (an
+  unordered dict in a closure, a fresh uncached constant) silently
+  defeats the persistent compilation cache that cheap restarts and the
+  recertify battery depend on.
+
+Everything here needs jax ≥ 8 CPU devices; the runners force
+``JAX_PLATFORMS=cpu`` + ``--xla_force_host_platform_device_count=8``
+when jax is not yet initialised (``scripts/ddlint.py`` sets both before
+any import, tests inherit the conftest's).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Set, Tuple
+
+from distributeddeeplearning_tpu.analysis import Finding, register
+
+# ---------------------------------------------------------------------------
+# HLO text walking (pure string work — testable without jax)
+# ---------------------------------------------------------------------------
+
+_COMP_HEAD_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\([^{]*)?\{")
+_WHILE_BODY_RE = re.compile(r"\bwhile\([^\n]*?body=%?([\w.\-]+)")
+_CALLED_RE = re.compile(
+    r"(?:to_apply|body|condition|branch_computations)=\{?%?([\w.\-{}, %]+)"
+)
+_ALLREDUCE_RE = re.compile(
+    r"=\s*\S+\s+(all-reduce|all-reduce-start)\b"
+)
+
+
+def hlo_computations(text: str) -> Dict[str, List[str]]:
+    """Computation name → its instruction lines (HLO text blocks start
+    at column 0 with ``%name (...) {`` or ``ENTRY ...``)."""
+    comps: Dict[str, List[str]] = {}
+    current: str = ""
+    for line in text.splitlines():
+        if line and not line[0].isspace():
+            m = _COMP_HEAD_RE.match(line.strip())
+            if m:
+                current = m.group(1)
+                comps[current] = []
+                continue
+        if current:
+            comps[current].append(line)
+    return comps
+
+
+def while_body_closure(text: str) -> Set[str]:
+    """Every computation reachable from a ``while`` loop's body —
+    "inside the scan", transitively through to_apply/call edges."""
+    comps = hlo_computations(text)
+    roots: Set[str] = set(_WHILE_BODY_RE.findall(text))
+    seen: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in comps:
+            continue
+        seen.add(name)
+        for line in comps[name]:
+            for m in _CALLED_RE.finditer(line):
+                for ref in re.findall(r"%?([\w.\-]+)", m.group(1)):
+                    if ref in comps and ref not in seen:
+                        frontier.append(ref)
+    return seen
+
+
+def allreduce_sites(text: str) -> List[Tuple[str, str]]:
+    """``(computation, instruction line)`` for every all-reduce."""
+    out: List[Tuple[str, str]] = []
+    for comp, lines in hlo_computations(text).items():
+        for line in lines:
+            if _ALLREDUCE_RE.search(line):
+                out.append((comp, line.strip()))
+    return out
+
+
+# XLA declines to alias tiny buffers (index vectors, scalar counters)
+# whose liveness doesn't pay for aliasing — verified at runtime: the
+# SlotEngine's s32[num_slots] position vectors stay undeleted after a
+# donated call while every KV tensor is reclaimed. Donation exists to
+# keep the BIG buffers single-resident, so leaves under a page are out
+# of scope for the rule.
+DONATION_BYTE_FLOOR = 4096
+
+
+def check_donation(
+    compiled,
+    args: Sequence,
+    donate_argnums: Sequence[int],
+    program: str,
+    path: str,
+) -> List[Finding]:
+    """Execute ``compiled`` once and verify every donated device leaf at
+    or above :data:`DONATION_BYTE_FLOOR` was reclaimed (``is_deleted``).
+
+    Runtime deletion is donation's actual semantics — the compiled
+    module's ``input_output_alias`` text reorders parameters, but a
+    donated-and-aliased input buffer is *deleted* by the call, and one
+    XLA declined to alias is not. The donated args must be
+    device-resident jax arrays (the real states/pools are)."""
+    import jax
+
+    donated = [
+        (f"arg{ai}{jax.tree_util.keystr(p)}", leaf)
+        for ai in donate_argnums
+        for p, leaf in jax.tree_util.tree_leaves_with_path(args[ai])
+        if isinstance(leaf, jax.Array)
+        and leaf.nbytes >= DONATION_BYTE_FLOOR
+    ]
+    if not donated:
+        return [Finding(
+            "hlo-donation", path, 1,
+            f"{program}: no device-resident donated leaves >= "
+            f"{DONATION_BYTE_FLOOR}B to audit — the donation check "
+            f"needs placed example args",
+        )]
+    compiled(*args)
+    missing = [p for p, leaf in donated if not leaf.is_deleted()]
+    if not missing:
+        return []
+    head = missing[:6]
+    more = f" (+{len(missing) - 6} more)" if len(missing) > 6 else ""
+    return [Finding(
+        "hlo-donation", path, 1,
+        f"{program}: donation not delivered for {len(missing)} donated "
+        f"leaves — {head}{more}; an unaliased donated buffer is "
+        f"double-resident in HBM (XLA only warns)",
+    )]
+
+
+def check_scan_collectives(
+    accum_text: str, plain_text: str, program: str, path: str
+) -> List[Finding]:
+    """No all-reduce inside the accum scan body; same all-reduce count
+    as the plain step (once per dispatch, not per microbatch)."""
+    findings: List[Finding] = []
+    inside = while_body_closure(accum_text)
+    if not inside:
+        findings.append(Finding(
+            "hlo-collectives", path, 1,
+            f"{program}: no while-loop computation in the compiled "
+            f"module — the ACCUM_STEPS scan is gone (unrolled or "
+            f"dropped), so collective placement cannot be audited",
+        ))
+    in_scan = [
+        (comp, line) for comp, line in allreduce_sites(accum_text)
+        if comp in inside
+    ]
+    if in_scan:
+        findings.append(Finding(
+            "hlo-collectives", path, 1,
+            f"{program}: {len(in_scan)} all-reduce(s) INSIDE the "
+            f"ACCUM_STEPS scan body (e.g. in computation "
+            f"{in_scan[0][0]!r}) — gradients must accumulate locally "
+            f"and reduce once per dispatch",
+        ))
+    n_plain = len(allreduce_sites(plain_text))
+    n_accum = len(allreduce_sites(accum_text))
+    if n_plain == 0:
+        findings.append(Finding(
+            "hlo-collectives", path, 1,
+            f"{program}: plain step compiled with ZERO all-reduces — "
+            f"the gradient reduction is missing (or the mesh collapsed "
+            f"to one device)",
+        ))
+    elif n_accum != n_plain:
+        findings.append(Finding(
+            "hlo-collectives", path, 1,
+            f"{program}: accum step has {n_accum} all-reduces vs the "
+            f"plain step's {n_plain} — collectives must run once per "
+            f"dispatch on the accumulated means",
+        ))
+    return findings
+
+
+def check_cache_key(
+    text_a: str, text_b: str, program: str, path: str
+) -> List[Finding]:
+    if text_a == text_b:
+        return []
+    # Name the first differing line — the usual culprits are unordered
+    # closures and fresh constants, both visible right at the diff.
+    for la, lb in zip(text_a.splitlines(), text_b.splitlines()):
+        if la != lb:
+            diff = f"first diff: {la.strip()[:80]!r} vs {lb.strip()[:80]!r}"
+            break
+    else:
+        diff = "texts differ in length"
+    return [Finding(
+        "hlo-cache-key", path, 1,
+        f"{program}: two lowers of the same config are not "
+        f"byte-identical ({diff}) — nondeterministic lowering defeats "
+        f"the persistent compilation cache",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# Program construction (tiny-LM scale, forced CPU mesh)
+# ---------------------------------------------------------------------------
+
+VOCAB, T = 32, 8
+
+
+def _require_devices() -> None:
+    import jax
+
+    n = jax.device_count()
+    if n < 8:
+        raise RuntimeError(
+            f"the HLO audit needs the forced 8-CPU-device mesh, got "
+            f"{n} — run via scripts/ddlint.py (it exports JAX_PLATFORMS="
+            f"cpu and --xla_force_host_platform_device_count=8 before "
+            f"importing jax) or under tests/conftest.py"
+        )
+
+
+def _cfg(**kw):
+    from distributeddeeplearning_tpu.config import TrainConfig
+
+    base = dict(
+        num_classes=VOCAB, batch_size_per_device=2, weight_decay=0.0,
+        compute_dtype="float32",
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _lm(**kw):
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+
+    return TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=T,
+        dtype=jnp.float32, **kw,
+    )
+
+
+def _tx():
+    import optax
+
+    return optax.sgd(0.1, momentum=0.9)
+
+
+def _token_batch(rows: int):
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, VOCAB, size=(rows, T + 1)).astype(np.int32)
+    return data[:, :-1], data[:, 1:]
+
+
+def _train_step_bundles() -> List[dict]:
+    """(program, lowered_a, lowered_b, args, donate) for each engine's
+    donated train step — the builder runs TWICE per engine so the
+    cache-key rule sees two independent closures."""
+    import jax
+
+    from distributeddeeplearning_tpu.parallel.mesh import create_mesh
+
+    _require_devices()
+    bundles: List[dict] = []
+
+    def lower_twice(build):
+        """build() -> (step_callable_with_lower, args). Runs build twice:
+        lowered module A and B must match byte-for-byte."""
+        step_a, args = build()
+        step_b, _ = build()
+        return step_a.lower(*args), step_b.lower(*args), args
+
+    # dp (plain + accum twin for the collective-placement rule)
+    def build_dp(accum: int):
+        def build():
+            from distributeddeeplearning_tpu.training.train_step import (
+                create_train_state,
+                make_train_step,
+                replicate_state,
+            )
+
+            mesh = create_mesh(axes=("data",), shape=(8,))
+            cfg = _cfg(accum_steps=accum)
+            model = _lm()
+            tx = _tx()
+            state = replicate_state(
+                create_train_state(
+                    model, cfg, tx, input_shape=(1, T),
+                    input_dtype=jax.numpy.int32,
+                ),
+                mesh,
+            )
+            step = make_train_step(model, tx, mesh, cfg, donate_state=True)
+            return step, (state, _token_batch(16))
+
+        return build
+
+    low_a, low_b, args = lower_twice(build_dp(1))
+    dp_plain = dict(
+        program="dp train step", lowered=low_a, lowered_b=low_b,
+        args=args, donate=(0,),
+    )
+    bundles.append(dp_plain)
+    low_a, low_b, args = lower_twice(build_dp(2))
+    bundles.append(dict(
+        program="dp train step (ACCUM_STEPS=2)", lowered=low_a,
+        lowered_b=low_b, args=args, donate=(0,), accum_twin_of=dp_plain,
+    ))
+
+    # pjit (GSPMD tensor parallel over data×model)
+    def build_pjit():
+        from distributeddeeplearning_tpu.training.pjit_step import (
+            build_pjit_state,
+            make_pjit_train_step,
+        )
+
+        mesh = create_mesh(axes=("data", "model"), shape=(4, 2))
+        cfg = _cfg(engine="pjit")
+        model = _lm()
+        tx = _tx()
+        state = build_pjit_state(
+            model, cfg, tx, mesh, input_shape=(1, T),
+            input_dtype=jax.numpy.int32,
+        )
+        step = make_pjit_train_step(model, tx, mesh, cfg)
+        return step, (state, _token_batch(16))
+
+    low_a, low_b, args = lower_twice(build_pjit)
+    bundles.append(dict(
+        program="pjit train step", lowered=low_a, lowered_b=low_b,
+        args=args, donate=(0,),
+    ))
+
+    # sp (ring attention over data×seq)
+    def build_sp():
+        from distributeddeeplearning_tpu.training.sp_step import (
+            make_sp_train_step,
+        )
+        from distributeddeeplearning_tpu.training.train_step import (
+            create_train_state,
+            replicate_state,
+        )
+
+        mesh = create_mesh(axes=("data", "seq"), shape=(2, 4))
+        cfg = _cfg()
+        model = _lm(attn_impl="ring", seq_axis="seq")
+        tx = _tx()
+        state = replicate_state(
+            create_train_state(
+                model, cfg, tx, input_shape=(1, T),
+                input_dtype=jax.numpy.int32,
+            ),
+            mesh,
+        )
+        step = make_sp_train_step(model, tx, mesh, cfg)
+        return step, (state, _token_batch(4))
+
+    low_a, low_b, args = lower_twice(build_sp)
+    bundles.append(dict(
+        program="sp train step", lowered=low_a, lowered_b=low_b,
+        args=args, donate=(0,),
+    ))
+
+    # pp (GPipe over data×pipe)
+    def build_pp():
+        from distributeddeeplearning_tpu.models.pipeline_lm import PipelineLM
+        from distributeddeeplearning_tpu.training.pp_step import (
+            create_pp_state,
+            make_pp_train_step,
+        )
+
+        mesh = create_mesh(axes=("data", "pipe"), shape=(2, 4))
+        cfg = _cfg(engine="pp", batch_size_per_device=2)
+        model = PipelineLM(
+            variant="tiny", vocab_size=VOCAB, max_seq_len=T,
+            num_stages=4, n_layers=4, dtype=jax.numpy.float32,
+        )
+        tx = _tx()
+        state = create_pp_state(model, cfg, tx, mesh, T)
+        step = make_pp_train_step(
+            model, tx, mesh, cfg, num_microbatches=2
+        )
+        return step, (state, _token_batch(4))
+
+    low_a, low_b, args = lower_twice(build_pp)
+    bundles.append(dict(
+        program="pp train step", lowered=low_a, lowered_b=low_b,
+        args=args, donate=(0,),
+    ))
+    return bundles
+
+
+def _audit_slot_engine(findings: Dict[str, List[Finding]]) -> None:
+    """Audit the SlotEngine's dense program set — the exact table
+    :meth:`SlotEngine.warmup` compiles (``program_specs``). The donation
+    check *executes* each program, consuming the donated pool, so the
+    pool is rebuilt between programs."""
+    import jax
+
+    import flax.linen as nn
+
+    _require_devices()
+    from distributeddeeplearning_tpu.serving.engine import SlotEngine
+
+    model = _lm()
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jax.numpy.zeros((2, T), jax.numpy.int32),
+        train=False,
+    )
+    params = nn.unbox(variables["params"])
+    eng = SlotEngine(
+        model, params, num_slots=2, max_len=T, buckets=(4, T)
+    )
+    n_programs = len(eng.program_specs())
+    for i in range(n_programs):
+        # Fresh pool per program: the previous donation check deleted it.
+        eng._pool = None
+        eng._draft_pool = None
+        spec = eng.program_specs()[i]
+        program = f"SlotEngine {spec.name}"
+        jitted = jax.jit(spec.fn, donate_argnums=spec.donate_argnums)
+        low_a = jitted.lower(*spec.example_args)
+        low_b = jitted.lower(*spec.example_args)
+        findings["hlo-cache-key"].extend(check_cache_key(
+            low_a.as_text(), low_b.as_text(), program, _ANALYSIS_PATH,
+        ))
+        # example_args[1] is the engine's device-resident pool (what a
+        # real tick donates), so the execution check sees true deletion.
+        findings["hlo-donation"].extend(check_donation(
+            low_a.compile(), spec.example_args, spec.donate_argnums,
+            program, _ANALYSIS_PATH,
+        ))
+
+
+_CACHE: Dict[str, List[Finding]] = {}
+_ANALYSIS_PATH = "distributeddeeplearning_tpu/analysis/hlo_audit.py"
+
+
+def _run_all() -> Dict[str, List[Finding]]:
+    """Build + lower + compile everything once; route findings by rule.
+
+    One pass feeds all three rules (compiles dominate the runtime; the
+    walks are string work), memoised per process."""
+    if _CACHE:
+        return _CACHE
+    findings: Dict[str, List[Finding]] = {
+        "hlo-donation": [], "hlo-collectives": [], "hlo-cache-key": [],
+    }
+    texts: Dict[str, str] = {}
+    for b in _train_step_bundles():
+        program = b["program"]
+        findings["hlo-cache-key"].extend(check_cache_key(
+            b["lowered"].as_text(), b["lowered_b"].as_text(),
+            program, _ANALYSIS_PATH,
+        ))
+        compiled = b["lowered"].compile()
+        texts[program] = compiled.as_text()
+        findings["hlo-donation"].extend(check_donation(
+            compiled, b["args"], b["donate"], program, _ANALYSIS_PATH,
+        ))
+        twin = b.get("accum_twin_of")
+        if twin is not None:
+            findings["hlo-collectives"].extend(check_scan_collectives(
+                texts[program], texts[twin["program"]], program,
+                _ANALYSIS_PATH,
+            ))
+    _audit_slot_engine(findings)
+    _CACHE.update(findings)
+    return _CACHE
+
+
+@register(
+    "hlo-donation", "hlo",
+    "donated buffers (train-step state, SlotEngine KV pool) are actually "
+    "aliased in the compiled modules",
+)
+def run_hlo_donation() -> List[Finding]:
+    return list(_run_all()["hlo-donation"])
+
+
+@register(
+    "hlo-collectives", "hlo",
+    "the dp step carries its gradient all-reduce; the ACCUM_STEPS scan "
+    "body carries none (collectives once per dispatch)",
+)
+def run_hlo_collectives() -> List[Finding]:
+    return list(_run_all()["hlo-collectives"])
+
+
+@register(
+    "hlo-cache-key", "hlo",
+    "the same config lowers to byte-identical HLO twice (persistent "
+    "compilation cache stability)",
+)
+def run_hlo_cache_key() -> List[Finding]:
+    return list(_run_all()["hlo-cache-key"])

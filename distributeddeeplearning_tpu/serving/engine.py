@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +68,30 @@ _INDEX_NAMES = ("cache_index", "pos_index")
 # follow the same block addressing.
 _PAGED_POOL_NAMES = ("paged_k", "paged_v", "paged_k_scale", "paged_v_scale")
 _TABLE_NAME = "block_table"
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One member of the engine's closed program set — everything needed
+    to compile it (:meth:`SlotEngine.warmup`) or to lower it for
+    inspection (the ddlint HLO audit, ``analysis/hlo_audit.py``). Both
+    consumers iterate the SAME table (:meth:`SlotEngine.program_specs`),
+    so what the lint audits is, by construction, what serves."""
+
+    name: str
+    fn: Callable
+    donate_argnums: Tuple[int, ...]
+    example_args: tuple
+    span: Dict[str, Any]  # labels for the `compile` span
+    _get: Callable[[], Any]  # read the installed executable slot
+    _set: Callable[[Any], None]  # install a compiled executable
+
+    @property
+    def installed(self) -> bool:
+        return self._get() is not None
+
+    def install(self, compiled: Any) -> None:
+        self._set(compiled)
 
 
 def default_buckets(max_len: int, smallest: int = 16) -> Tuple[int, ...]:
@@ -665,6 +689,160 @@ class SlotEngine:
                 n += 1 + len(self.buckets)  # draft phase + draft prefills
         return n
 
+    def _ensure_pools(self) -> None:
+        """Build the KV pool(s) the program set closes over (idempotent).
+
+        Canonical pool layout: index leaves are [num_slots] vectors (the
+        decode step's per-slot positions) so every program — prefill
+        passes them through, decode rewrites them — sees one stable
+        signature; everything else keeps its template shape (dense K/V
+        rows batched over slots; in the paged layout the block pools are
+        batch-independent shared tensors and the block table is
+        [num_slots, blocks_per_slot] routing data). Each leaf gets its
+        OWN buffer: the pool is donated, and donating one aliased buffer
+        through several leaves is an XLA error."""
+
+        def zero_pool(template):
+            return jax.device_put(self._unflatten({
+                path: jnp.zeros(
+                    (self.num_slots,) if path[-1] in _INDEX_NAMES
+                    else leaf.shape,
+                    jnp.int32 if path[-1] in _INDEX_NAMES else leaf.dtype,
+                )
+                for path, leaf in template.items()
+            }))
+
+        if self._pool is None:
+            self._pool = zero_pool(self._template)
+        if (
+            self.spec_enabled
+            and self.spec_draft == "int8"
+            and self._draft_pool is None
+        ):
+            self._draft_pool = zero_pool(self._draft_template)
+
+    def program_specs(self) -> List[ProgramSpec]:
+        """The closed program set as data: one :class:`ProgramSpec` per
+        member, each carrying the traced fn, donation, example args and
+        the executable slot it installs into. :meth:`warmup` compiles
+        exactly this list; the ddlint HLO audit lowers exactly this list
+        — a program can't exist in one view and not the other."""
+        self._ensure_pools()
+        s, k = self.num_slots, self.spec_k
+        paged = self.kv_layout == "paged"
+        specs: List[ProgramSpec] = []
+
+        def slot_attr(attr):
+            return (
+                lambda: getattr(self, attr),
+                lambda ex: setattr(self, attr, ex),
+            )
+
+        def slot_dict(d, key):
+            return (
+                lambda: d.get(key),
+                lambda ex: d.__setitem__(key, ex),
+            )
+
+        if paged:
+            decode_args = (
+                self.params, self._pool,
+                np.zeros(s, np.int32), np.zeros(s, np.int32),
+                np.zeros((s, self.blocks_per_slot), np.int32),
+                np.zeros((s, 2), np.uint32),
+                np.zeros(s, np.float32), np.zeros(s, np.int32),
+                np.zeros(s, np.float32),
+                np.full(s, -1, np.int32),
+            )
+        else:
+            decode_args = (
+                self.params, self._pool,
+                np.zeros(s, np.int32), np.zeros(s, np.int32),
+                np.zeros((s, 2), np.uint32),
+                np.zeros(s, np.float32),
+                np.zeros(s, np.int32), np.zeros(s, np.float32),
+                np.full(s, -1, np.int32),
+            )
+        specs.append(ProgramSpec(
+            "decode",
+            self._decode_paged_fn if paged else self._decode_fn,
+            (1,), decode_args,
+            {"what": "serve_decode", "slots": s},
+            *slot_attr("_decode_exec"),
+        ))
+        for bucket in self.buckets:
+            if paged:
+                prefill_args = (
+                    self.params, self._pool,
+                    np.zeros((1, self.blocks_per_slot), np.int32),
+                    np.zeros(1, np.int32),
+                    np.zeros((1, bucket), np.int32),
+                    np.int32(0), np.zeros(2, np.uint32),
+                    np.float32(0), np.int32(0), np.float32(0),
+                    np.int32(-1),
+                )
+            else:
+                prefill_args = (
+                    self.params, self._pool,
+                    np.int32(0), np.zeros((1, bucket), np.int32),
+                    np.int32(1), np.zeros(2, np.uint32),
+                    np.float32(0), np.int32(0), np.float32(0),
+                    np.int32(-1),
+                )
+            specs.append(ProgramSpec(
+                f"prefill_b{bucket}",
+                self._prefill_paged_fn if paged else self._prefill_fn,
+                (1,), prefill_args,
+                {"what": f"serve_prefill_b{bucket}"},
+                *slot_dict(self._prefill_exec, bucket),
+            ))
+        if self.spec_enabled:
+            verify_args = [
+                self.params, self._pool,
+                np.zeros((s, k + 1), np.int32), np.zeros(s, np.int32),
+            ]
+            if paged:
+                verify_args.append(
+                    np.zeros((s, self.blocks_per_slot), np.int32)
+                )
+            verify_args += [
+                np.zeros((s, k + 1, 2), np.uint32),
+                np.zeros(s, np.float32), np.zeros(s, np.int32),
+                np.zeros(s, np.float32),
+            ]
+            specs.append(ProgramSpec(
+                "spec_verify",
+                self._spec_verify_paged_fn if paged else self._spec_verify_fn,
+                (1,), tuple(verify_args),
+                {"what": "serve_spec_verify", "k": k},
+                *slot_attr("_spec_verify_exec"),
+            ))
+            if self.spec_draft == "int8":
+                specs.append(ProgramSpec(
+                    "spec_draft",
+                    self._spec_draft_fn,
+                    (1,),
+                    (
+                        self._draft_params, self._draft_pool,
+                        np.zeros((s, 2), np.int32), np.zeros(s, np.int32),
+                    ),
+                    {"what": "serve_spec_draft", "k": k},
+                    *slot_attr("_spec_draft_exec"),
+                ))
+                for bucket in self.buckets:
+                    specs.append(ProgramSpec(
+                        f"spec_draft_prefill_b{bucket}",
+                        self._spec_draft_prefill_fn,
+                        (1,),
+                        (
+                            self._draft_params, self._draft_pool,
+                            np.int32(0), np.zeros((1, bucket), np.int32),
+                        ),
+                        {"what": f"serve_spec_draft_prefill_b{bucket}"},
+                        *slot_dict(self._spec_draft_prefill_exec, bucket),
+                    ))
+        return specs
+
     def warmup(self) -> Dict[str, float]:
         """AOT-compile the decode step and every bucket's prefill
         (idempotent) — plus, with speculation on, the verify and draft
@@ -672,95 +850,19 @@ class SlotEngine:
         ``compile_count == programs_expected`` for its whole lifetime."""
         log = get_logger()
         t_all = time.perf_counter()
-        if self._pool is None:
-            # Canonical pool layout: index leaves are [num_slots]
-            # vectors (the decode step's per-slot positions) so every
-            # program — prefill passes them through, decode rewrites
-            # them — sees one stable signature; everything else keeps
-            # its template shape (dense K/V rows batched over slots; in
-            # the paged layout the block pools are batch-independent
-            # shared tensors and the block table is [num_slots,
-            # blocks_per_slot] routing data). Each leaf gets its OWN
-            # buffer: the pool is donated, and donating one aliased
-            # buffer through several leaves is an XLA error.
-            self._pool = jax.device_put(self._unflatten({
-                path: jnp.zeros(
-                    (self.num_slots,) if path[-1] in _INDEX_NAMES
-                    else leaf.shape,
-                    jnp.int32 if path[-1] in _INDEX_NAMES else leaf.dtype,
-                )
-                for path, leaf in self._template.items()
-            }))
-        s = self.num_slots
-        paged = self.kv_layout == "paged"
-        if self._decode_exec is None:
-            with obs.span("compile", what="serve_decode", slots=s):
-                t0 = time.perf_counter()
-                if paged:
-                    self._decode_exec = (
-                        jax.jit(self._decode_paged_fn, donate_argnums=(1,))
-                        .lower(
-                            self.params, self._pool,
-                            np.zeros(s, np.int32), np.zeros(s, np.int32),
-                            np.zeros((s, self.blocks_per_slot), np.int32),
-                            np.zeros((s, 2), np.uint32),
-                            np.zeros(s, np.float32), np.zeros(s, np.int32),
-                            np.zeros(s, np.float32),
-                            np.full(s, -1, np.int32),
-                        )
-                        .compile()
-                    )
-                else:
-                    self._decode_exec = (
-                        jax.jit(self._decode_fn, donate_argnums=(1,))
-                        .lower(
-                            self.params, self._pool,
-                            np.zeros(s, np.int32), np.zeros(s, np.int32),
-                            np.zeros((s, 2), np.uint32),
-                            np.zeros(s, np.float32),
-                            np.zeros(s, np.int32), np.zeros(s, np.float32),
-                            np.full(s, -1, np.int32),
-                        )
-                        .compile()
-                    )
-                self.compile_sec += time.perf_counter() - t0
-            self.compile_count += 1
-        for bucket in self.buckets:
-            if bucket in self._prefill_exec:
+        for ps in self.program_specs():
+            if ps.installed:
                 continue
-            with obs.span("compile", what=f"serve_prefill_b{bucket}"):
+            with obs.span("compile", **ps.span):
                 t0 = time.perf_counter()
-                if paged:
-                    self._prefill_exec[bucket] = (
-                        jax.jit(self._prefill_paged_fn, donate_argnums=(1,))
-                        .lower(
-                            self.params, self._pool,
-                            np.zeros((1, self.blocks_per_slot), np.int32),
-                            np.zeros(1, np.int32),
-                            np.zeros((1, bucket), np.int32),
-                            np.int32(0), np.zeros(2, np.uint32),
-                            np.float32(0), np.int32(0), np.float32(0),
-                            np.int32(-1),
-                        )
-                        .compile()
-                    )
-                else:
-                    self._prefill_exec[bucket] = (
-                        jax.jit(self._prefill_fn, donate_argnums=(1,))
-                        .lower(
-                            self.params, self._pool,
-                            np.int32(0), np.zeros((1, bucket), np.int32),
-                            np.int32(1), np.zeros(2, np.uint32),
-                            np.float32(0), np.int32(0), np.float32(0),
-                            np.int32(-1),
-                        )
-                        .compile()
-                    )
+                ps.install(
+                    jax.jit(ps.fn, donate_argnums=ps.donate_argnums)
+                    .lower(*ps.example_args)
+                    .compile()
+                )
                 self.compile_sec += time.perf_counter() - t0
             self.compile_count += 1
-        if self.spec_enabled:
-            self._warmup_spec(paged)
-        if paged:
+        if self.kv_layout == "paged":
             self._emit_pool_gauges()
         acct = self.byte_accounting()
         obs.gauge(
@@ -777,80 +879,10 @@ class SlotEngine:
             self.compile_count, len(self.buckets), list(self.buckets),
             (f" + spec k={self.spec_k} draft={self.spec_draft}"
              if self.spec_enabled else ""),
-            time.perf_counter() - t_all, s, self.max_len,
+            time.perf_counter() - t_all, self.num_slots, self.max_len,
         )
         obs.gauge("serve.programs", float(self.compile_count))
         return info
-
-    def _warmup_spec(self, paged: bool) -> None:
-        """Compile the speculative members of the program set: the
-        [S, K+1] batched verify (dense or paged twin) and — int8 draft —
-        the one-dispatch draft phase plus a draft prefill per bucket."""
-        s, k = self.num_slots, self.spec_k
-        if self._spec_verify_exec is None:
-            with obs.span("compile", what="serve_spec_verify", k=k):
-                t0 = time.perf_counter()
-                args = [
-                    self.params, self._pool,
-                    np.zeros((s, k + 1), np.int32), np.zeros(s, np.int32),
-                ]
-                if paged:
-                    args.append(
-                        np.zeros((s, self.blocks_per_slot), np.int32)
-                    )
-                args += [
-                    np.zeros((s, k + 1, 2), np.uint32),
-                    np.zeros(s, np.float32), np.zeros(s, np.int32),
-                    np.zeros(s, np.float32),
-                ]
-                fn = (
-                    self._spec_verify_paged_fn if paged
-                    else self._spec_verify_fn
-                )
-                self._spec_verify_exec = (
-                    jax.jit(fn, donate_argnums=(1,)).lower(*args).compile()
-                )
-                self.compile_sec += time.perf_counter() - t0
-            self.compile_count += 1
-        if self.spec_draft != "int8":
-            return
-        if self._draft_pool is None:
-            self._draft_pool = jax.device_put(self._unflatten({
-                path: jnp.zeros(
-                    (self.num_slots,) if path[-1] in _INDEX_NAMES
-                    else leaf.shape,
-                    jnp.int32 if path[-1] in _INDEX_NAMES else leaf.dtype,
-                )
-                for path, leaf in self._draft_template.items()
-            }))
-        if self._spec_draft_exec is None:
-            with obs.span("compile", what="serve_spec_draft", k=k):
-                t0 = time.perf_counter()
-                self._spec_draft_exec = (
-                    jax.jit(self._spec_draft_fn, donate_argnums=(1,))
-                    .lower(
-                        self._draft_params, self._draft_pool,
-                        np.zeros((s, 2), np.int32), np.zeros(s, np.int32),
-                    )
-                    .compile()
-                )
-                self.compile_sec += time.perf_counter() - t0
-            self.compile_count += 1
-        for bucket in self.buckets:
-            if bucket in self._spec_draft_prefill_exec:
-                continue
-            with obs.span("compile", what=f"serve_spec_draft_prefill_b{bucket}"):
-                t0 = time.perf_counter()
-                self._spec_draft_prefill_exec[bucket] = (
-                    jax.jit(self._spec_draft_prefill_fn, donate_argnums=(1,))
-                    .lower(
-                        self._draft_params, self._draft_pool,
-                        np.int32(0), np.zeros((1, bucket), np.int32),
-                    )
-                    .compile()
-                )
-                self.compile_sec += time.perf_counter() - t0
-            self.compile_count += 1
 
     # -- slot lifecycle ----------------------------------------------------
 

@@ -16,8 +16,13 @@ script closes both:
   seconds becomes heavy), replacing the fragile grep/awk recipe the doc
   used to carry.
 
-Exit code 1 when the pruned list differs from what was on disk and
-``--check`` was passed (CI drift guard); always writes otherwise.
+``--check`` additionally runs the ddlint static-analysis suite
+(``scripts/ddlint.py --changed-ok``, docs/ANALYSIS.md) so ``make
+check``'s gate is ONE command: heavy-list drift, lint invariants, then
+the fast tier.
+
+Exit code 1 when the pruned list differs from what was on disk (or the
+lint gate fails) and ``--check`` was passed; always writes otherwise.
 """
 
 from __future__ import annotations
@@ -101,6 +106,17 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    lint_rc = 0
+    if args.check:
+        # The lint gate rides the same CI entry point (`make check`).
+        # --changed-ok: a refreshed lint.json is fine; only unsuppressed
+        # findings (printed by ddlint itself) fail the gate.
+        lint_rc = subprocess.call(
+            [sys.executable, os.path.join(REPO, "scripts", "ddlint.py"),
+             "--changed-ok"],
+            cwd=REPO,
+        )
+
     current: Set[str] = set(collected_nodeids())
     heavy = read_heavy()
 
@@ -127,13 +143,13 @@ def main(argv=None) -> int:
 
     if new == heavy:
         print(f"{HEAVY_FILE} is current ({n_heavy} entries)")
-        return 0
+        return 1 if lint_rc else 0
     if args.check:
         print(f"STALE: {HEAVY_FILE} needs refreshing (run make heavy-refresh)")
         return 1
     write_heavy(new)
     print(f"wrote {HEAVY_FILE} ({len(heavy)} -> {n_heavy} entries)")
-    return 0
+    return 1 if lint_rc else 0
 
 
 if __name__ == "__main__":

@@ -204,6 +204,9 @@ PROTOCOLS = {
 _PROTOCOL_VARS = (
     "BENCH_MODEL", "BENCH_BATCH", "BENCH_SEQ_LEN", "BENCH_DECODE",
     "BENCH_DEPTH", "BENCH_IMAGE_SIZE", "BENCH_SCALING", "ACCUM_STEPS",
+    # Decode-row geometry + the profile-capture dir (a leaked
+    # BENCH_PROFILE would trace-capture every row's measured region).
+    "BENCH_PROMPT_LEN", "BENCH_NEW_TOKENS", "BENCH_PROFILE",
     "BENCH_VOCAB", "SERVE_REQUESTS", "SERVE_MAX_NEW", "SERVE_RATE_RPS",
     "SERVE_SLOTS", "SERVE_BUCKETS", "SERVE_QUEUE_DEPTH", "SERVE_SEED",
     "SERVE_DEADLINE_MS", "SERVE_PREFILLS_PER_STEP", "SERVE_TOP_K_CAP",
@@ -212,6 +215,10 @@ _PROTOCOL_VARS = (
     "SERVE_KV_DTYPE", "SERVE_WEIGHT_DTYPE", "SERVE_QUANT_MATCH_MIN",
     "SERVE_SPEC_K", "SERVE_SPEC_DRAFT", "SERVE_SPEC_NGRAM_N",
     "SERVE_SPEC_MIN_SPEEDUP",
+    # Telemetry-feedback knobs (docs/SERVING.md adaptive admission): an
+    # ambient adaptive policy (or a stale rollup path) must never derate
+    # a protocol row's admission mid-measurement.
+    "SERVE_ADMISSION_POLICY", "SERVE_ROLLUP_PATH",
     "SERVE_REPLICAS", "SERVE_TENANT_WEIGHTS", "SERVE_PLACEMENT",
     "SERVE_FLEET_QUEUE_DEPTH", "SERVE_FLEET_QUANTUM",
     "SERVE_FLEET_MIN_SCALING", "SERVE_FLEET_SINGLE_CORE_MIN",
@@ -280,6 +287,25 @@ def run_protocol(name: str, env_over: dict, timeout_s: float) -> dict:
     return rec
 
 
+def lint_verdict(commit: str) -> dict:
+    """The ddlint verdict recorded beside the bench rows (docs/
+    ANALYSIS.md): read ``lint.json`` (``make lint`` writes it) and note
+    staleness against this battery's commit — so a static-invariant
+    regression shows up in the recert trajectory, not only in CI."""
+    try:
+        with open(os.path.join(REPO, "lint.json")) as f:
+            lint = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"missing": True}
+    return {
+        "ok": bool(lint.get("ok")),
+        "commit": lint.get("commit"),
+        "stale": lint.get("commit") != commit,
+        "findings": lint.get("findings_total", 0),
+        "suppressions": lint.get("suppressions_total", 0),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--only", default=None,
@@ -297,6 +323,7 @@ def main(argv=None) -> int:
     out = {
         "commit": commit,
         "date": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "lint": lint_verdict(commit),
         "rows": {},
     }
     for name in names:
@@ -309,7 +336,8 @@ def main(argv=None) -> int:
             json.dump(out, f, indent=1)
     ok = all(r.get("value", 0) > 0 for r in out["rows"].values())
     print(json.dumps({"recertified": ok, "commit": commit,
-                      "rows": len(out["rows"])}))
+                      "rows": len(out["rows"]),
+                      "lint_ok": out["lint"].get("ok", False)}))
     return 0 if ok else 1
 
 
