@@ -48,6 +48,7 @@ HOT_PATHS = (
     "models/transformer_lm.py",
     "models/vit.py",
     "ops/attention.py",
+    "ops/pallas/paged_decode.py",
     "serving/engine.py",
     "serving/sampling.py",
     "training/accum.py",

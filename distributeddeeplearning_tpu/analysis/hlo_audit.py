@@ -23,6 +23,20 @@ walks the compiled modules:
   unordered dict in a closure, a fresh uncached constant) silently
   defeats the persistent compilation cache that cheap restarts and the
   recertify battery depend on.
+* ``hlo-fused-decode`` — the SERVE_DECODE_KERNEL=fused decode program
+  carries the fused-kernel evidence (the Pallas custom-call on TPU; the
+  ``paged_decode_fused`` scope marker under CPU interpret mode) and
+  contains NO full-sequence-length dequantized K/V buffer — the
+  gather→dequant→HBM round-trip the kernel exists to eliminate. The
+  detector self-calibrates: the stitched XLA twin of the same config
+  MUST trip it, so a silently-broken detector is itself a finding.
+  Fused programs also go through the cache-key rule.
+* ``hlo-async-collective`` — the pjit/sp gradient all-reduces carry the
+  ``training/overlap.py`` scope tag in their HLO metadata (provable on
+  any backend, including this CPU CI), and wherever the backend DOES
+  split them (``all-reduce-start``, TPU async flags), every start has a
+  matching ``-done`` with real compute scheduled between — latency
+  actually hidden, not just requested.
 
 Everything here needs jax ≥ 8 CPU devices; the runners force
 ``JAX_PLATFORMS=cpu`` + ``--xla_force_host_platform_device_count=8``
@@ -216,6 +230,140 @@ def check_cache_key(
         f"byte-identical ({diff}) — nondeterministic lowering defeats "
         f"the persistent compilation cache",
     )]
+
+
+# Dequant detector: an f32 `multiply` whose output is a >= 4-dim
+# tensor ([B, L, H, Dh] dense rows, [B, mb, bs, H, Dh] gathered blocks)
+# holding at least a full KV pool's worth of elements is the stitched
+# path's dequantize-into-HBM buffer. Attention/MLP activations at
+# decode are [B, 1, ...] 3-dim tensors, and everything the fused
+# kernel multiplies in f32 is block-sized or lane scratch — neither
+# matches both conditions.
+_F32_MUL_RE = re.compile(r"=\s*f32\[([\d,]*)\][^=]*\bmultiply\(")
+
+
+def _full_kv_multiplies(text: str, min_elems: int) -> List[str]:
+    """Instruction lines whose f32 multiply output is >= 4-dim and
+    spans >= min_elems elements (the full-sequence dequantized K/V
+    signature)."""
+    out = []
+    for line in text.splitlines():
+        m = _F32_MUL_RE.search(line)
+        if not m:
+            continue
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        if len(dims) < 4:
+            continue
+        n = 1
+        for d in dims:
+            n *= d
+        if n >= min_elems:
+            out.append(line.strip())
+    return out
+
+
+def check_fused_decode(
+    fused_text: str, xla_text: str, min_elems: int, program: str,
+    path: str,
+) -> List[Finding]:
+    """The fused decode program's two invariants + detector calibration
+    against its stitched XLA twin (see module docstring)."""
+    from distributeddeeplearning_tpu.ops.pallas.paged_decode import (
+        FUSED_SCOPE,
+    )
+
+    findings: List[Finding] = []
+    # Kernel evidence: the TPU lowering is a custom-call; the CPU
+    # interpret lowering inlines the grid but keeps the named scope in
+    # instruction metadata. Either form proves dispatch reached the
+    # kernel.
+    if "custom-call" not in fused_text and FUSED_SCOPE not in fused_text:
+        findings.append(Finding(
+            "hlo-fused-decode", path, 1,
+            f"{program}: neither a Pallas custom-call nor the "
+            f"{FUSED_SCOPE!r} scope marker appears in the lowered decode "
+            f"program — SERVE_DECODE_KERNEL=fused never reached the "
+            f"kernel (ops/pallas/paged_decode.py dispatch lost)",
+        ))
+    hits = _full_kv_multiplies(fused_text, min_elems)
+    if hits:
+        findings.append(Finding(
+            "hlo-fused-decode", path, 1,
+            f"{program}: fused decode still materialises a "
+            f"full-sequence dequantized K/V buffer "
+            f"({hits[0][:80]!r}) — the gather→dequant chain the kernel "
+            f"exists to eliminate is back",
+        ))
+    if not _full_kv_multiplies(xla_text, min_elems):
+        findings.append(Finding(
+            "hlo-fused-decode", path, 1,
+            f"{program}: the stitched XLA twin shows NO full-sequence "
+            f"dequantized K/V multiply — the detector lost its signal "
+            f"(threshold {min_elems} elems); fix _full_kv_multiplies "
+            f"before trusting the fused assertion",
+        ))
+    return findings
+
+
+_COMPUTE_OP_RE = re.compile(
+    r"=\s*\S+\s+(fusion|dot|convolution|multiply|add|subtract|divide|"
+    r"exponential|custom-call)\b"
+)
+
+
+def check_async_collectives(
+    text: str, program: str, path: str,
+) -> List[Finding]:
+    """The overlap contract on one compiled train step: (a) >= 1
+    all-reduce carries the ``training/overlap.py`` tag; (b) every
+    ``all-reduce-start`` pairs with a ``-done`` and has compute
+    scheduled between them (vacuously true where the backend never
+    splits — the CPU CI proves (a), a TPU build proves both)."""
+    from distributeddeeplearning_tpu.training.overlap import OVERLAP_SCOPE
+
+    findings: List[Finding] = []
+    sites = allreduce_sites(text)
+    if not any(OVERLAP_SCOPE in line for _, line in sites):
+        findings.append(Finding(
+            "hlo-async-collective", path, 1,
+            f"{program}: none of the {len(sites)} all-reduce sites "
+            f"carries the {OVERLAP_SCOPE!r} tag — the step builder lost "
+            f"the overlap scope (training/overlap.py; "
+            f"TrainConfig.async_collectives)",
+        ))
+    for comp, lines in hlo_computations(text).items():
+        starts: Dict[str, int] = {}
+        for i, line in enumerate(lines):
+            m = re.match(r"\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=.*"
+                         r"\ball-reduce-start\b", line)
+            if m:
+                starts[m.group(1)] = i
+        for name, i in starts.items():
+            done = next(
+                (j for j, line in enumerate(lines)
+                 if "all-reduce-done" in line and name in line), None,
+            )
+            if done is None:
+                findings.append(Finding(
+                    "hlo-async-collective", path, 1,
+                    f"{program}: all-reduce-start %{name} in {comp} has "
+                    f"no matching all-reduce-done — unfinished async "
+                    f"collective",
+                ))
+                continue
+            between = [
+                line for line in lines[i + 1:done]
+                if _COMPUTE_OP_RE.search(line)
+                and "all-reduce" not in line
+            ]
+            if not between:
+                findings.append(Finding(
+                    "hlo-async-collective", path, 1,
+                    f"{program}: all-reduce-start %{name} in {comp} "
+                    f"completes with no compute scheduled between start "
+                    f"and done — the async pair hides nothing",
+                ))
+    return findings
 
 
 # ---------------------------------------------------------------------------
@@ -457,6 +605,50 @@ def _audit_slot_engine(findings: Dict[str, List[Finding]]) -> None:
         ))
 
 
+def _audit_fused_decode(findings: Dict[str, List[Finding]]) -> None:
+    """Lower the fused decode program next to its stitched XLA twin
+    (paged + int8 — the config whose dequant buffer is detectable) and
+    run the fused invariants + cache-key stability on it."""
+    import jax
+
+    import flax.linen as nn
+
+    _require_devices()
+    from distributeddeeplearning_tpu.serving.engine import SlotEngine
+
+    model = _lm()
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jax.numpy.zeros((2, T), jax.numpy.int32),
+        train=False,
+    )
+    params = nn.unbox(variables["params"])
+    texts: Dict[str, str] = {}
+    for kern in ("fused", "xla"):
+        eng = SlotEngine(
+            model, params, num_slots=2, max_len=T, buckets=(4, T),
+            kv_layout="paged", block_size=4, kv_dtype="int8",
+            decode_kernel=kern,
+        )
+        spec = next(s for s in eng.program_specs() if s.name == "decode")
+        jitted = jax.jit(spec.fn, donate_argnums=spec.donate_argnums)
+        low_a = jitted.lower(*spec.example_args)
+        if kern == "fused":
+            low_b = jitted.lower(*spec.example_args)
+            findings["hlo-cache-key"].extend(check_cache_key(
+                low_a.as_text(), low_b.as_text(),
+                "SlotEngine decode (fused)", _ANALYSIS_PATH,
+            ))
+        texts[kern] = low_a.compile().as_text()
+    # Full pool worth of elements: num_slots * max_len * hidden
+    # (H * Dh = hidden; tiny variant hidden = 128).
+    min_elems = 2 * T * 128
+    findings["hlo-fused-decode"].extend(check_fused_decode(
+        texts["fused"], texts["xla"], min_elems,
+        "SlotEngine decode (paged int8)", _ANALYSIS_PATH,
+    ))
+
+
 _CACHE: Dict[str, List[Finding]] = {}
 _ANALYSIS_PATH = "distributeddeeplearning_tpu/analysis/hlo_audit.py"
 
@@ -470,6 +662,7 @@ def _run_all() -> Dict[str, List[Finding]]:
         return _CACHE
     findings: Dict[str, List[Finding]] = {
         "hlo-donation": [], "hlo-collectives": [], "hlo-cache-key": [],
+        "hlo-fused-decode": [], "hlo-async-collective": [],
     }
     texts: Dict[str, str] = {}
     for b in _train_step_bundles():
@@ -489,7 +682,16 @@ def _run_all() -> Dict[str, List[Finding]]:
                 texts[program], texts[twin["program"]], program,
                 _ANALYSIS_PATH,
             ))
+    # The overlap tag is a step-builder invariant of the sharded
+    # engines whose gradient reduction the builders own (pjit GSPMD +
+    # sp shard_map; dp's reduction lives in train_step/accum, pp's in
+    # its pipeline loop — out of the ASYNC_COLLECTIVES contract).
+    for program in ("pjit train step", "sp train step"):
+        findings["hlo-async-collective"].extend(check_async_collectives(
+            texts[program], program, _ANALYSIS_PATH,
+        ))
     _audit_slot_engine(findings)
+    _audit_fused_decode(findings)
     _CACHE.update(findings)
     return _CACHE
 
@@ -519,3 +721,23 @@ def run_hlo_collectives() -> List[Finding]:
 )
 def run_hlo_cache_key() -> List[Finding]:
     return list(_run_all()["hlo-cache-key"])
+
+
+@register(
+    "hlo-fused-decode", "hlo",
+    "the SERVE_DECODE_KERNEL=fused decode program reaches the Pallas "
+    "kernel and materialises no full-sequence dequantized K/V buffer "
+    "(detector calibrated against the stitched XLA twin)",
+)
+def run_hlo_fused_decode() -> List[Finding]:
+    return list(_run_all()["hlo-fused-decode"])
+
+
+@register(
+    "hlo-async-collective", "hlo",
+    "pjit/sp gradient all-reduces carry the overlap tag; any "
+    "all-reduce-start pairs with a -done with compute between "
+    "(training/overlap.py, ASYNC_COLLECTIVES)",
+)
+def run_hlo_async_collective() -> List[Finding]:
+    return list(_run_all()["hlo-async-collective"])

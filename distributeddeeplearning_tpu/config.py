@@ -200,6 +200,14 @@ class TrainConfig:
     # before it returns — what the deterministic fault oracles need so
     # "killed after step N" implies "checkpoint N committed".
     checkpoint_async: bool = True
+    # Collective/compute overlap (env ASYNC_COLLECTIVES, default on):
+    # the step builders tag the gradient all-reduces with the
+    # training/overlap.py named scope so (a) the TPU async-collective
+    # XLA flags (overlap.XLA_TPU_FLAGS) can split them into
+    # all-reduce-start/done pairs that hide under the next layer's
+    # matmul, and (b) analysis/hlo_audit.py can prove the tag/pairing at
+    # HLO level. Off = untagged synchronous reductions (debug baseline).
+    async_collectives: bool = True
     resume: bool = True  # env RESUME (the supervisor re-asserts it)
     # Elastic worlds (env ELASTIC; docs/ROBUSTNESS.md elasticity
     # section): this run may be a shrunken/regrown relaunch of a larger
@@ -393,6 +401,8 @@ class TrainConfig:
             kw["checkpoint_keep"] = int(e["CHECKPOINT_KEEP"])
         if "CHECKPOINT_ASYNC" in e:
             kw["checkpoint_async"] = _str_to_bool(e["CHECKPOINT_ASYNC"])
+        if "ASYNC_COLLECTIVES" in e:
+            kw["async_collectives"] = _str_to_bool(e["ASYNC_COLLECTIVES"])
         if "RESUME" in e:
             kw["resume"] = _str_to_bool(e["RESUME"])
         if "NONFINITE_ACTION" in e:

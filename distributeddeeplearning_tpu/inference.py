@@ -46,7 +46,7 @@ PyTree = object
 
 
 def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0,
-                   kv_dtype: str = ""):
+                   kv_dtype: str = "", decode_kernel: str = ""):
     """The model re-staged for KV-cache decoding (shared contract of
     this module and ``serving.SlotEngine``): mutable-cache attention,
     plain XLA einsum (decode is bandwidth-bound; Pallas/ring paths are
@@ -55,9 +55,10 @@ def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0,
     ``paged_blocks > 0`` selects the paged cache layout (one
     ``[paged_blocks, paged_block_size, H, Dh]`` pool per layer addressed
     through per-row block tables — the serving engine's
-    ``kv_layout="paged"``). ``kv_dtype="int8"`` stores the cache (dense
-    rows or block pool alike) as symmetric int8 + per-head f32 scales
-    (``ops/quant.py`` — the engine's ``SERVE_KV_DTYPE``). The sequential
+    ``kv_layout="paged"``). ``kv_dtype="int8"``/``"fp8"`` stores the
+    cache (dense rows or block pool alike) quantized + per-head f32
+    scales (``ops/quant.py`` — the engine's ``SERVE_KV_DTYPE``). The
+    sequential
     path here always decodes dense/unquantized, so the kwargs are only
     passed through when set (custom models without the fields keep
     working).
@@ -79,6 +80,12 @@ def decode_variant(model, *, paged_blocks: int = 0, paged_block_size: int = 0,
                   paged_block_size=int(paged_block_size))
     if kv_dtype and kv_dtype != "bf16":
         kw.update(kv_dtype=str(kv_dtype))
+    if decode_kernel and decode_kernel != "xla":
+        # "fused" = the Pallas online-softmax decode kernel
+        # (ops/pallas/paged_decode.py, SERVE_DECODE_KERNEL). Only the
+        # vector-position decode paths dispatch to it; the sequential
+        # scalar-index path below stays XLA either way.
+        kw.update(decode_kernel=str(decode_kernel))
     return model.clone(decode=True, attn_impl="xla", seq_axis=None, **kw)
 
 

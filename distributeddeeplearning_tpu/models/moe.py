@@ -208,9 +208,12 @@ class MoEDecoderBlock(nn.Module):
     # Paged KV cache (serving tier; see models/vit.Attention): 0 = dense.
     paged_blocks: int = 0
     paged_block_size: int = 0
-    # KV-cache storage dtype ("" = compute dtype, "int8" = quantized
-    # cache + f32 scales; models/vit.Attention, SERVE_KV_DTYPE).
+    # KV-cache storage dtype ("" = compute dtype, "int8"/"fp8" =
+    # quantized cache + f32 scales; models/vit.Attention, SERVE_KV_DTYPE).
     kv_dtype: str = ""
+    # Decode attention lowering ("xla" | "fused"; models/vit.Attention,
+    # SERVE_DECODE_KERNEL).
+    decode_kernel: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -228,6 +231,7 @@ class MoEDecoderBlock(nn.Module):
             paged_blocks=self.paged_blocks,
             paged_block_size=self.paged_block_size,
             kv_dtype=self.kv_dtype,
+            decode_kernel=self.decode_kernel,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)

@@ -50,9 +50,12 @@ class DecoderBlock(nn.Module):
     # Paged KV cache (serving tier; see models/vit.Attention): 0 = dense.
     paged_blocks: int = 0
     paged_block_size: int = 0
-    # KV-cache storage dtype ("" = compute dtype, "int8" = quantized
-    # cache + f32 scales; models/vit.Attention, SERVE_KV_DTYPE).
+    # KV-cache storage dtype ("" = compute dtype, "int8"/"fp8" =
+    # quantized cache + f32 scales; models/vit.Attention, SERVE_KV_DTYPE).
     kv_dtype: str = ""
+    # Decode attention lowering ("xla" | "fused"; models/vit.Attention,
+    # SERVE_DECODE_KERNEL).
+    decode_kernel: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -68,6 +71,7 @@ class DecoderBlock(nn.Module):
             paged_blocks=self.paged_blocks,
             paged_block_size=self.paged_block_size,
             kv_dtype=self.kv_dtype,
+            decode_kernel=self.decode_kernel,
             name="attn",
         )(y, train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -116,6 +120,11 @@ class TransformerLM(nn.Module):
     # scale per head per position; the gather dequantizes before the
     # masked-score math (ops/quant.py). "" = store the compute dtype.
     kv_dtype: str = ""
+    # Decode attention lowering (SERVE_DECODE_KERNEL): "xla" = stitched
+    # gather→dequant→masked-softmax ops; "fused" = the Pallas
+    # online-softmax kernel (ops/pallas/paged_decode.py) on the
+    # vector-position decode paths (models/vit.Attention).
+    decode_kernel: str = "xla"
     # Gradient checkpointing (rematerialization): recompute each block's
     # activations during backward instead of storing them — trades ~1
     # extra forward of FLOPs for O(depth) activation memory. REMAT=1.
@@ -223,6 +232,7 @@ class TransformerLM(nn.Module):
                     paged_blocks=self.paged_blocks,
                     paged_block_size=self.paged_block_size,
                     kv_dtype=self.kv_dtype,
+                    decode_kernel=self.decode_kernel,
                     name=f"block{i}",
                 )(x, train)
             else:
@@ -237,6 +247,7 @@ class TransformerLM(nn.Module):
                     paged_blocks=self.paged_blocks,
                     paged_block_size=self.paged_block_size,
                     kv_dtype=self.kv_dtype,
+                    decode_kernel=self.decode_kernel,
                     name=f"block{i}",
                 )(x, train)
 

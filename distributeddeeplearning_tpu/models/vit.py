@@ -149,21 +149,41 @@ class Attention(nn.Module):
     paged_block_size: int = 0
     # KV-cache storage dtype (serving tier, SERVE_KV_DTYPE): "" keeps
     # the compute dtype; "int8" stores symmetric int8 K/V plus one f32
-    # scale per head per position (ops/quant.py) — writes quantize, the
-    # decode gather dequantizes to the compute dtype before the shared
-    # masked-score tail. Halves the per-step KV bytes decode streams
-    # (scale overhead 4/Dh per element, itemized by decode_audit).
+    # scale per head per position (ops/quant.py), "fp8" stores
+    # float8_e4m3fn with the same scale contract — writes quantize, the
+    # decode path dequantizes to the compute dtype before the masked
+    # scores (in-register under decode_kernel="fused"). Halves the
+    # per-step KV bytes decode streams (scale overhead 4/Dh per
+    # element, itemized by decode_audit). Validated through the
+    # ops/quant.py dtype registry so every boundary names the same
+    # supported list.
     kv_dtype: str = ""
+    # Decode attention lowering (serving tier, SERVE_DECODE_KERNEL):
+    # "xla" stitches gather → dequant → masked einsum from stock ops
+    # (materializing a full-length compute-dtype K/V view); "fused"
+    # runs the Pallas online-softmax kernel (ops/pallas/paged_decode.py)
+    # that walks the block table / dense rows and dequantizes
+    # in-register — same masked-score math, no full-length HBM
+    # round-trip. Applies to the vector-position decode paths (the
+    # serving engine); scalar-position callers (inference.generate,
+    # dense prefill) stay on the XLA path.
+    decode_kernel: str = "xla"
 
     def _kv_quantized(self) -> bool:
-        if self.kv_dtype in ("", "bf16"):
-            return False
-        if self.kv_dtype != "int8":
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
+        quantlib.validate_store_dtype(
+            "kv_dtype", self.kv_dtype, extra=("",)
+        )
+        return self.kv_dtype not in ("", "bf16")
+
+    def _decode_fused(self) -> bool:
+        if self.decode_kernel not in ("xla", "fused"):
             raise ValueError(
-                f"kv_dtype must be '', 'bf16' or 'int8', got "
-                f"{self.kv_dtype!r}"
+                f"decode_kernel must be one of ('xla', 'fused'), got "
+                f"{self.decode_kernel!r}"
             )
-        return True
+        return self.decode_kernel == "fused"
 
     def _paged_decode_attention(self, q, k, v, ci):
         """Block-table-indexed variant of the decode cache: same math
@@ -175,7 +195,12 @@ class Attention(nn.Module):
         b, t = q.shape[0], q.shape[1]
         heads, dh = k.shape[-2], k.shape[-1]
         quant = self._kv_quantized()
-        kv_dt = jnp.int8 if quant else k.dtype
+        if quant:
+            from distributeddeeplearning_tpu.ops import quant as quantlib
+
+            kv_dt = quantlib.kv_store_dtype(self.kv_dtype)
+        else:
+            kv_dt = k.dtype
         max_blocks = -(-k.shape[1] // bs) if self.is_initializing() else None
         ck = self.variable(
             "cache", "paged_k", jnp.zeros, (nb, bs, heads, dh), kv_dt
@@ -221,10 +246,11 @@ class Attention(nn.Module):
         )
         flat = (pb * bs + pos % bs).reshape(-1)  # [B*t] pool row ids
         if quant:
-            from distributeddeeplearning_tpu.ops.quant import quantize_int8
+            from distributeddeeplearning_tpu.ops.quant import quantize_kv
 
-            k, k_scale = quantize_int8(k, axis=-1)  # int8 + [B,t,H,1] f32
-            v, v_scale = quantize_int8(v, axis=-1)
+            # 8-bit payload + [B,t,H,1] f32 scales (int8 or fp8)
+            k, k_scale = quantize_kv(k, self.kv_dtype, axis=-1)
+            v, v_scale = quantize_kv(v, self.kv_dtype, axis=-1)
             cks.value = (
                 cks.value.reshape(nb * bs, heads, 1)
                 .at[flat].set(k_scale.reshape(-1, heads, 1))
@@ -246,6 +272,21 @@ class Attention(nn.Module):
             .reshape(nb, bs, heads, dh)
         )
         ci.value = idx + t
+        if self._decode_fused():
+            # Fused tier: the kernel walks the table itself — physical
+            # blocks stream through VMEM in the storage dtype and
+            # dequantize in-register; the [B, mb*bs, H, Dh] gathered
+            # view below never materializes.
+            from distributeddeeplearning_tpu.ops.pallas.paged_decode import (
+                fused_decode_attention,
+            )
+
+            return fused_decode_attention(
+                q, ck.value, cv.value, pos,
+                k_scale=cks.value if quant else None,
+                v_scale=cvs.value if quant else None,
+                block_table=table, block_size=bs,
+            )
         # Gather this row's logical view [B, mb*bs, H, Dh]; positions
         # beyond the written depth are masked exactly like the dense
         # path's unwritten tail (bitwise-invariant: masked scores are
@@ -253,15 +294,15 @@ class Attention(nn.Module):
         k_all = jnp.take(ck.value, table, axis=0).reshape(b, mb * bs, heads, dh)
         v_all = jnp.take(cv.value, table, axis=0).reshape(b, mb * bs, heads, dh)
         if quant:
-            from distributeddeeplearning_tpu.ops.quant import dequantize_int8
+            from distributeddeeplearning_tpu.ops.quant import dequantize_store
 
-            k_all = dequantize_int8(
+            k_all = dequantize_store(
                 k_all,
                 jnp.take(cks.value, table, axis=0)
                 .reshape(b, mb * bs, heads, 1),
                 self.dtype,
             )
-            v_all = dequantize_int8(
+            v_all = dequantize_store(
                 v_all,
                 jnp.take(cvs.value, table, axis=0)
                 .reshape(b, mb * bs, heads, 1),
@@ -316,7 +357,12 @@ class Attention(nn.Module):
         if self.paged_blocks:
             return self._paged_decode_attention(q, k, v, ci)
         quant = self._kv_quantized()
-        kv_dt = jnp.int8 if quant else k.dtype
+        if quant:
+            from distributeddeeplearning_tpu.ops import quant as quantlib
+
+            kv_dt = quantlib.kv_store_dtype(self.kv_dtype)
+        else:
+            kv_dt = k.dtype
         ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, kv_dt)
         cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, kv_dt)
         if quant:
@@ -339,12 +385,12 @@ class Attention(nn.Module):
         writes = [(ck, k), (cv, v)]
         if quant:
             from distributeddeeplearning_tpu.ops.quant import (
-                dequantize_int8,
-                quantize_int8,
+                dequantize_store,
+                quantize_kv,
             )
 
-            kq, k_scale = quantize_int8(k, axis=-1)
-            vq, v_scale = quantize_int8(v, axis=-1)
+            kq, k_scale = quantize_kv(k, self.kv_dtype, axis=-1)
+            vq, v_scale = quantize_kv(v, self.kv_dtype, axis=-1)
             writes = [(ck, kq), (cv, vq), (cks, k_scale), (cvs, v_scale)]
         if jnp.ndim(idx) == 0:
             for var, upd in writes:
@@ -365,9 +411,25 @@ class Attention(nn.Module):
                 var.value = write(var.value, upd, idx)
             q_pos = idx[:, None] + jnp.arange(t)  # [B, t]
         ci.value = idx + t
+        if q_pos.ndim == 2 and self._decode_fused():
+            # Fused tier, dense rows: storage-dtype cache streams
+            # through the kernel block-wise, dequant in-register — the
+            # full-length dequantized copy below never materializes.
+            # Scalar-position callers (inference.generate's lockstep
+            # batch, the dense prefill program) keep the XLA path: the
+            # fused kernel's contract is per-row positions.
+            from distributeddeeplearning_tpu.ops.pallas.paged_decode import (
+                fused_decode_attention,
+            )
+
+            return fused_decode_attention(
+                q, ck.value, cv.value, q_pos,
+                k_scale=cks.value if quant else None,
+                v_scale=cvs.value if quant else None,
+            )
         if quant:
-            k_all = dequantize_int8(ck.value, cks.value, self.dtype)
-            v_all = dequantize_int8(cv.value, cvs.value, self.dtype)
+            k_all = dequantize_store(ck.value, cks.value, self.dtype)
+            v_all = dequantize_store(cv.value, cvs.value, self.dtype)
         else:
             k_all, v_all = ck.value, cv.value
         return self._masked_decode_scores(q, k_all, v_all, q_pos)

@@ -1,0 +1,307 @@
+"""Fused paged/dense decode attention as a Pallas TPU kernel.
+
+The serving tier's decode hot path (``models/vit.Attention`` with
+``decode=True``) was the one tier still stitched from stock XLA ops:
+gather K/V through the block table into a **full-sequence-length HBM
+buffer**, dequantize that copy, then run masked scores over it — the
+exact memory round-trip the paged layout was built to avoid
+(PagedAttention) and the exact fusion online softmax eliminates
+(FlashAttention). This kernel replaces the stitched chain with one
+program per ``(row, head)``:
+
+* walk the slot's **block table** (scalar-prefetched into SMEM so the
+  table drives the K/V BlockSpec index maps — the gather never
+  materializes),
+* stream each K/V block through VMEM in its **storage dtype** (bf16 /
+  int8 / fp8) and dequantize **in-register** (``q·scale`` broadcast),
+* accumulate the **online-softmax** masked attention with per-row
+  positions — covering the dense row layout, the paged pool, the
+  trash-block-0 convention, and the speculative ``[S, K+1]`` verify
+  view with one kernel body.
+
+Numerics mirror ``Attention._masked_decode_scores``: queries are
+pre-scaled by ``head_dim**-0.5``, masked lanes take
+``jnp.finfo(f32).min``, the softmax state is f32 throughout. The
+recurrence re-associates the sum, so fused-vs-XLA logits agree to ULP
+noise (exact for the common single-K-block serving shapes) — the greedy
+token-stream parity the serve_bench gate checks rides on that
+(``tests/test_paged_decode_kernel.py``).
+
+Masking subsumes the paged trash-block convention for free: an
+unallocated logical block's table entry points at block 0, but every
+logical position it would contribute lies beyond the row's ``q_pos``,
+so its (finite — the trash block only ever holds quantized writes)
+values meet a zero softmax weight.
+
+On non-TPU backends the kernel runs in Pallas interpreter mode, so the
+CPU test/CI tier exercises the identical code path; calls are wrapped
+in ``jax.named_scope(FUSED_SCOPE)`` so lowered programs carry an
+auditable marker either way (``analysis/hlo_audit.py`` fused-decode
+rule — on TPU the Mosaic custom-call itself is the marker).
+
+Layout: ``q`` is ``[B, t, H, d]`` (framework-wide BTHD); the dense
+cache is ``[B, L, H, d]``; the paged pool is ``[nb, bs, H, d]`` with an
+int32 ``[B, mb]`` block table; quantized tiers add f32 scales with a
+size-1 tail axis (``ops/quant.py``'s broadcast contract).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Marker the serving integration wraps kernel calls in; the HLO audit
+# greps lowered decode programs for it (interpret-mode lowering has no
+# custom-call to look for).
+FUSED_SCOPE = "paged_decode_fused"
+
+_LANES = 128  # VPU lane width: m/l scratch rows are lane-replicated
+
+# Scratch init: large-negative instead of -inf keeps exp() NaN-free.
+_NEG_INF = -1e30
+
+# Masked score value — jnp.finfo(f32).min, matching the XLA path's
+# `jnp.where(mask, scores, jnp.finfo(jnp.float32).min)` bit for bit.
+_MASK_VALUE = float(jnp.finfo(jnp.float32).min)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pick_block(pref: int, t: int) -> int:
+    """Largest K block ≤ ``pref`` that minimises trailing padding
+    (same policy as ``flash.py``)."""
+    if t <= 128:
+        return min(pref, _ceil_to(t, 8))
+    cands = []
+    c = max(pref, 128)
+    while c >= 128:
+        cands.append(c)
+        c //= 2
+    return min(cands, key=lambda c: (_ceil_to(t, c), -c))
+
+
+def _decode_kernel(*refs, scale: float, kv_len: int, block_k: int,
+                   quant: bool, paged: bool):
+    """One ``(row, head, k-block)`` program with K innermost.
+
+    ``refs`` order (static per instantiation): an SMEM block-table ref
+    leads iff ``paged``; then q, k, v, [k_scale, v_scale iff quant],
+    q_pos, the output, and the m/l/acc VMEM scratch. The online-softmax
+    state persists across the sequential K dimension exactly as in
+    ``flash.py``.
+    """
+    refs = list(refs)
+    if paged:
+        refs.pop(0)  # table ref: consumed by the index maps, not here
+    q_ref, k_ref, v_ref = refs[:3]
+    ks_ref = vs_ref = None
+    i = 3
+    if quant:
+        ks_ref, vs_ref = refs[3:5]
+        i = 5
+    pos_ref, o_ref, m_scr, l_scr, acc_scr = refs[i:i + 5]
+
+    j = pl.program_id(2)
+    t, d = q_ref.shape[1], q_ref.shape[3]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, :, 0, :]  # [t, d], compute dtype
+    kq = k_ref[0, :, 0, :]  # [block_k, d], storage dtype
+    vq = v_ref[0, :, 0, :]
+    if quant:
+        # Dequantize in-register: the full-length HBM round-trip the
+        # stitched path paid is exactly what never happens here.
+        k = (kq.astype(jnp.float32) * ks_ref[0, :, 0, :]).astype(q.dtype)
+        v = (vq.astype(jnp.float32) * vs_ref[0, :, 0, :]).astype(q.dtype)
+    else:
+        k = kq.astype(q.dtype)
+        v = vq.astype(q.dtype)
+
+    # Logical K positions are block-major in BOTH layouts: the paged
+    # grid walks the table in logical-block order, so block j always
+    # covers positions [j·bs, (j+1)·bs) regardless of which physical
+    # block the index map fetched.
+    k_idx = j * block_k + lax.broadcasted_iota(jnp.int32, (t, block_k), 1)
+    q_pos = pos_ref[0]  # [t, 1] int32
+    mask = jnp.logical_and(k_idx <= q_pos, k_idx < kv_len)
+    # Grid padding past kv_len reads undefined memory; the mask drops
+    # those scores, and zeroing v kills the 0·NaN poisoning path.
+    v = jnp.where(
+        (j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len,
+        v, jnp.zeros_like(v),
+    )
+
+    s = lax.dot_general(
+        (q * scale).astype(q.dtype), k,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [t, block_k]
+    s = jnp.where(mask, s, _MASK_VALUE)
+
+    m_prev = m_scr[:]  # [t, _LANES], lane-replicated
+    l_prev = l_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    m_scr[:] = m_new
+    l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha[:, :1] + lax.dot_general(
+        p.astype(v.dtype), v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def fused_decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    block_table: Optional[jnp.ndarray] = None,
+    block_size: int = 0,
+    kv_len: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused masked decode attention over a dense row cache or a paged
+    block pool.
+
+    Args:
+      q: ``[B, t, H, d]`` queries in the compute dtype (``t`` is 1 for
+        plain decode, ``K+1`` for the speculative verify view, or the
+        bucket length for vector-position prefill).
+      k_cache / v_cache: dense ``[B, L, H, d]`` or (with
+        ``block_table``) the paged pool ``[nb, block_size, H, d]``, in
+        the storage dtype (compute dtype, int8, or fp8).
+      q_pos: ``[B, t]`` int32 absolute positions of the query rows —
+        keys at positions ``> q_pos`` (and past ``kv_len``) are masked.
+      k_scale / v_scale: f32 dequant scales with a size-1 tail axis
+        (dense ``[B, L, H, 1]`` / paged ``[nb, block_size, H, 1]``);
+        both present or both absent.
+      block_table: ``[B, mb]`` int32 physical-block ids (paged layout
+        only); entry 0 is the trash block.
+      block_size: positions per pool block (paged layout only).
+      kv_len: logical key length (dense default: ``L``; paged default:
+        ``mb·block_size``).
+      interpret: Pallas interpreter mode; defaults to "not on TPU".
+
+    Returns ``[B, t, H, d]`` in ``q.dtype``.
+    """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    quant = k_scale is not None
+    paged = block_table is not None
+    if paged and block_size <= 0:
+        raise ValueError("paged layout requires block_size > 0")
+    if q_pos.ndim != 2:
+        raise ValueError(
+            f"q_pos must be [B, t] per-row positions, got shape "
+            f"{q_pos.shape} (the fused kernel serves the vector-index "
+            f"decode paths; scalar-index callers use the XLA path)"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, t, h, d = q.shape
+    if paged:
+        mb = block_table.shape[1]
+        bk = block_size
+        n_kb = mb
+        length = mb * block_size
+    else:
+        length = k_cache.shape[1]
+        bk = _pick_block(128, length)
+        n_kb = _ceil_to(length, bk) // bk
+    if kv_len is None:
+        kv_len = length
+
+    kernel = functools.partial(
+        _decode_kernel, scale=float(d) ** -0.5, kv_len=kv_len,
+        block_k=bk, quant=quant, paged=paged,
+    )
+
+    pos3 = q_pos.astype(jnp.int32)[:, :, None]  # [B, t, 1]: [t,1] blocks
+    q_spec = pl.BlockSpec((1, t, 1, d), lambda bb, hh, jj, *_: (bb, 0, hh, 0))
+    pos_spec = pl.BlockSpec((1, t, 1), lambda bb, hh, jj, *_: (bb, 0, 0))
+    out_spec = pl.BlockSpec((1, t, 1, d), lambda bb, hh, jj, *_: (bb, 0, hh, 0))
+    if paged:
+        # The scalar-prefetched table drives the K/V index maps: grid
+        # step j fetches physical block table[b, j] straight into VMEM.
+        def kv_idx(bb, hh, jj, table):
+            return (table[bb, jj], 0, hh, 0)
+    else:
+        def kv_idx(bb, hh, jj, *_):
+            return (bb, jj, hh, 0)
+    kv_spec = pl.BlockSpec((1, bk, 1, d), kv_idx)
+    scale_spec = pl.BlockSpec((1, bk, 1, 1), kv_idx)
+
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q, k_cache, v_cache]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    in_specs.append(pos_spec)
+    args.append(pos3)
+
+    scratch = [
+        pltpu.VMEM((t, _LANES), jnp.float32),
+        pltpu.VMEM((t, _LANES), jnp.float32),
+        pltpu.VMEM((t, d), jnp.float32),
+    ]
+    grid = (b, h, n_kb)
+    out_shape = jax.ShapeDtypeStruct((b, t, h, d), q.dtype)
+    # K (minor) carries the online-softmax recurrence and must stay
+    # sequential; rows and heads parallelise freely.
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+    with jax.named_scope(FUSED_SCOPE):
+        if paged:
+            call = pl.pallas_call(
+                kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=grid,
+                    in_specs=in_specs,
+                    out_specs=out_spec,
+                    scratch_shapes=scratch,
+                ),
+                out_shape=out_shape,
+                compiler_params=compiler_params,
+                interpret=interpret,
+            )
+            return call(block_table.astype(jnp.int32), *args)
+        call = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )
+        return call(*args)

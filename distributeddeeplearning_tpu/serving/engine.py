@@ -175,10 +175,13 @@ class SlotEngine:
         prefix_cache: bool = True,
         kv_dtype: str = "bf16",
         weight_dtype: str = "bf16",
+        decode_kernel: str = "xla",
         spec_k: int = 0,
         spec_draft: str = "int8",
         spec_ngram_n: int = 3,
     ) -> None:
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if kv_layout not in ("dense", "paged"):
@@ -186,15 +189,27 @@ class SlotEngine:
                 f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
             )
         # "bf16" means *native* (store the model's compute dtype — the
-        # pre-quantization behaviour); "int8" engages ops/quant.py.
-        if kv_dtype not in ("bf16", "int8"):
-            raise ValueError(
-                f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}"
+        # pre-quantization behaviour); "int8"/"fp8" engage ops/quant.py.
+        # The supported tiers live in ONE registry (quant.KV_DTYPES /
+        # quant.WEIGHT_DTYPES) so the enum, the env parsing (ServeConfig)
+        # and this boundary reject unknown dtypes with the same list.
+        quantlib.validate_store_dtype("kv_dtype", kv_dtype)
+        quantlib.validate_store_dtype("weight_dtype", weight_dtype)
+        # fp8 is platform-gated: where the compiled backend cannot
+        # round-trip float8 we fall back to the int8 tier (same scale
+        # layout, one extra bit of mantissa) rather than crash mid-build.
+        if "fp8" in (kv_dtype, weight_dtype) and not quantlib.fp8_supported():
+            get_logger().warning(
+                "fp8 storage unsupported on backend %r; falling back to "
+                "int8 (kv_dtype=%s weight_dtype=%s)",
+                jax.default_backend(), kv_dtype, weight_dtype,
             )
-        if weight_dtype not in ("bf16", "int8"):
+            kv_dtype = "int8" if kv_dtype == "fp8" else kv_dtype
+            weight_dtype = "int8" if weight_dtype == "fp8" else weight_dtype
+        if decode_kernel not in ("xla", "fused"):
             raise ValueError(
-                f"weight_dtype must be 'bf16' or 'int8', got "
-                f"{weight_dtype!r}"
+                f"decode_kernel must be one of ('xla', 'fused'), got "
+                f"{decode_kernel!r}"
             )
         validate_spec_config(spec_k, spec_draft, spec_ngram_n, weight_dtype)
         model_max = getattr(model, "max_seq_len", None)
@@ -215,9 +230,20 @@ class SlotEngine:
         self.kv_layout = kv_layout
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
+        self.decode_kernel = decode_kernel
         self.allocator: Optional[BlockAllocator] = None
         self.prefix_cache = bool(prefix_cache) and kv_layout == "paged"
-        quant_kw = dict(kv_dtype="int8") if kv_dtype == "int8" else {}
+        quant_kw = dict(kv_dtype=kv_dtype) if kv_dtype != "bf16" else {}
+        # The kernel knob changes the decode programs' LOWERING, not the
+        # program set: decode_variant threads it into the model clone and
+        # vit.Attention dispatches the vector-position decode paths to
+        # the fused Pallas kernel (ops/pallas/paged_decode.py). The
+        # draft model below stays XLA — its lookahead scratch decode is
+        # not on the audited hot path.
+        kernel_kw = (
+            dict(decode_kernel=decode_kernel) if decode_kernel != "xla"
+            else {}
+        )
         if kv_layout == "paged":
             if block_size < 1:
                 raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -232,13 +258,13 @@ class SlotEngine:
             self.allocator = BlockAllocator(self.num_blocks, self.block_size)
             self.decode_model = decode_variant(
                 model, paged_blocks=self.num_blocks,
-                paged_block_size=self.block_size, **quant_kw,
+                paged_block_size=self.block_size, **quant_kw, **kernel_kw,
             )
         else:
             self.block_size = 0
             self.blocks_per_slot = 0
             self.num_blocks = 0
-            self.decode_model = decode_variant(model, **quant_kw)
+            self.decode_model = decode_variant(model, **quant_kw, **kernel_kw)
         # Speculative decode tier (docs/SERVING.md): spec_k draft
         # proposals per slot per tick, then ONE fixed-shape batched
         # verify runs the target over [num_slots, spec_k + 1] positions.
@@ -276,24 +302,22 @@ class SlotEngine:
             self.params = params
         else:
             self.params = jax.device_put(params)
-        # Inference weight quantization (SERVE_WEIGHT_DTYPE=int8): a
+        # Inference weight quantization (SERVE_WEIGHT_DTYPE=int8|fp8): a
         # one-shot tree pass — matmul kernels + the tied embedding
-        # become int8 + per-channel f32 scales; the decode programs
+        # become int8/fp8 + per-channel f32 scales; the decode programs
         # dequantize on use, so what each step STREAMS is the quantized
         # bytes (ops/quant.py).
-        if weight_dtype == "int8":
-            from distributeddeeplearning_tpu.ops import quant as quantlib
-
-            self.params = jax.jit(quantlib.quantize_params)(self.params)
+        if weight_dtype != "bf16":
+            self.params = jax.jit(
+                lambda p: quantlib.quantize_params(p, dtype=weight_dtype)
+            )(self.params)
         # Self-speculative draft weights: the PR-8 int8 tier of the SAME
-        # model — one-shot quantized at build (weight_dtype="int8" is
-        # rejected above for this source, so self.params is the native
-        # tree). The draft programs dequantize on use (_spec_draft_fn),
-        # so draft steps stream the int8 + scale bytes.
+        # model — one-shot quantized at build (any quantized
+        # weight_dtype is rejected above for this source, so self.params
+        # is the native tree). The draft programs dequantize on use
+        # (_spec_draft_fn), so draft steps stream the int8 + scale bytes.
         self._draft_params = None
         if self.spec_draft == "int8":
-            from distributeddeeplearning_tpu.ops import quant as quantlib
-
             self._draft_params = jax.jit(quantlib.quantize_params)(
                 self.params
             )
@@ -416,11 +440,11 @@ class SlotEngine:
     # -- traced programs ---------------------------------------------------
 
     def _live_params(self, params):
-        """Dequant-on-use (``weight_dtype="int8"``): inside the traced
-        program the quantized tree is the *streamed* operand; the f32
-        view XLA rebuilds here is a fused temporary, so per-step param
-        traffic is the int8 + scale bytes."""
-        if self.weight_dtype != "int8":
+        """Dequant-on-use (``weight_dtype="int8"``/``"fp8"``): inside
+        the traced program the quantized tree is the *streamed* operand;
+        the f32 view XLA rebuilds here is a fused temporary, so per-step
+        param traffic is the quantized + scale bytes."""
+        if self.weight_dtype == "bf16":
             return params
         from distributeddeeplearning_tpu.ops import quant as quantlib
 
@@ -869,6 +893,13 @@ class SlotEngine:
             "serve.kv_bytes_per_token", float(acct["kv_bytes_per_token"])
         )
         obs.gauge("serve.param_bytes", float(acct["param_bytes"]))
+        # Which decode lowering this engine compiled (0 = xla stitched,
+        # 1 = fused Pallas kernel); the string rides as a label.
+        obs.gauge(
+            "serve.decode_kernel",
+            1.0 if self.decode_kernel == "fused" else 0.0,
+            kernel=self.decode_kernel,
+        )
         info = {
             "compile_sec": self.compile_sec,
             "programs": float(self.compile_count),

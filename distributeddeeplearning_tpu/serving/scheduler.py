@@ -38,6 +38,10 @@ Env contract (``ServeConfig.from_env``; docs/ORCHESTRATION.md):
 ``SERVE_DEADLINE_MS``, ``SERVE_PREFILLS_PER_STEP``,
 ``SERVE_SPEC_K`` / ``SERVE_SPEC_DRAFT`` / ``SERVE_SPEC_NGRAM_N``
 (speculative tier — a tick then commits 1..K+1 tokens per slot),
+``SERVE_KV_DTYPE`` / ``SERVE_WEIGHT_DTYPE`` (``bf16`` | ``int8`` |
+``fp8`` — the quantized decode tier, ops/quant.py),
+``SERVE_DECODE_KERNEL`` (``xla`` | ``fused`` — the Pallas decode
+kernel, ops/pallas/paged_decode.py),
 ``SERVE_ADMISSION_POLICY`` (``static`` | ``adaptive``),
 ``SERVE_ROLLUP_PATH`` (default ``$OBS_DIR/rollup.json``).
 """
@@ -383,11 +387,17 @@ class ServeConfig:
     num_blocks: int = 0
     prefix_cache: bool = True
     # Quantized decode tier (docs/SERVING.md): "bf16" = native compute
-    # dtype; "int8" stores the KV pool / streams the inference weights
-    # as symmetric int8 + f32 scales (ops/quant.py). Orthogonal to
-    # kv_layout — the paged pool quantizes too.
+    # dtype; "int8"/"fp8" store the KV pool / stream the inference
+    # weights quantized + f32 scales (ops/quant.py — the registry
+    # quant.KV_DTYPES/WEIGHT_DTYPES is the source of truth; fp8 is
+    # platform-gated with an int8 fallback). Orthogonal to kv_layout —
+    # the paged pool quantizes too.
     kv_dtype: str = "bf16"
     weight_dtype: str = "bf16"
+    # Decode attention lowering (SERVE_DECODE_KERNEL): "xla" = stitched
+    # gather→dequant→masked-softmax; "fused" = the Pallas online-softmax
+    # kernel (ops/pallas/paged_decode.py). Same program set either way.
+    decode_kernel: str = "xla"
     # Speculative decode tier (docs/SERVING.md): spec_k > 0 turns every
     # scheduler tick into draft-K-then-verify — 1..K+1 tokens committed
     # per slot per tick. spec_draft picks the proposal source ("int8" =
@@ -428,6 +438,9 @@ class ServeConfig:
             ) not in ("0", "false", "off"),
             kv_dtype=str(e.get("SERVE_KV_DTYPE", cls.kv_dtype)),
             weight_dtype=str(e.get("SERVE_WEIGHT_DTYPE", cls.weight_dtype)),
+            decode_kernel=str(
+                e.get("SERVE_DECODE_KERNEL", cls.decode_kernel)
+            ),
             spec_k=int(e.get("SERVE_SPEC_K", cls.spec_k)),
             spec_draft=str(e.get("SERVE_SPEC_DRAFT", cls.spec_draft)),
             spec_ngram_n=int(e.get("SERVE_SPEC_NGRAM_N", cls.spec_ngram_n)),
@@ -449,10 +462,22 @@ class ServeConfig:
         )
 
     def engine_kwargs(self) -> dict:
+        # Reject unknown dtypes/kernels HERE, naming the supported list,
+        # so a typo'd SERVE_* env var fails before an engine is built.
+        from distributeddeeplearning_tpu.ops import quant as quantlib
+
+        quantlib.validate_store_dtype("kv_dtype", self.kv_dtype)
+        quantlib.validate_store_dtype("weight_dtype", self.weight_dtype)
+        if self.decode_kernel not in ("xla", "fused"):
+            raise ValueError(
+                f"decode_kernel must be one of ('xla', 'fused'), got "
+                f"{self.decode_kernel!r} (SERVE_DECODE_KERNEL)"
+            )
         kw = dict(
             num_slots=self.num_slots, buckets=self.buckets,
             top_k_cap=self.top_k_cap, kv_layout=self.kv_layout,
             kv_dtype=self.kv_dtype, weight_dtype=self.weight_dtype,
+            decode_kernel=self.decode_kernel,
         )
         if self.kv_layout == "paged":
             kw.update(

@@ -45,14 +45,16 @@ def validate_spec_config(
             f"spec_draft must be one of {DRAFT_SOURCES} when spec_k > 0, "
             f"got {spec_draft!r} (SERVE_SPEC_DRAFT)"
         )
-    if spec_draft == "int8" and weight_dtype == "int8":
+    if spec_draft == "int8" and weight_dtype not in ("", "bf16"):
         # The self-speculative draft IS the int8 quantization of the
-        # target; an int8 target leaves no cheaper tier to draft from
-        # (and would double-quantize the already-quantized tree).
+        # target; a quantized target (int8 OR fp8) leaves no cheaper
+        # tier to draft from (and would double-quantize the
+        # already-quantized tree).
         raise ValueError(
             "spec_draft='int8' requires the native (bf16) weight tier — "
-            "with weight_dtype='int8' the target already runs the int8 "
-            "weights; use spec_draft='ngram' or drop SERVE_WEIGHT_DTYPE"
+            f"with weight_dtype={weight_dtype!r} the target already runs "
+            "quantized weights; use spec_draft='ngram' or drop "
+            "SERVE_WEIGHT_DTYPE"
         )
     if spec_draft == "ngram" and spec_ngram_n < 2:
         raise ValueError(

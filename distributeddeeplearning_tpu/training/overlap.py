@@ -1,0 +1,77 @@
+"""Collective/compute overlap for the step builders (ASYNC_COLLECTIVES).
+
+The gradient all-reduce is the one collective every data-parallel step
+pays, and on a synchronous lowering it serializes behind the whole
+backward pass: DCN/ICI latency that could hide under the next layer's
+matmul instead lands on the critical path. XLA's async-collective
+machinery fixes this at the compiler level — an ``all-reduce`` becomes
+an ``all-reduce-start``/``all-reduce-done`` pair and the scheduler
+moves independent compute between them — but only when (a) the backend
+flags are on and (b) the reductions are schedulable, i.e. not fused
+into a shape the latency-hiding scheduler refuses to split.
+
+This module is the whole contract in one place:
+
+* :data:`OVERLAP_SCOPE` — the ``jax.named_scope`` tag the step builders
+  (``training/sp_step.py``, ``training/pjit_step.py``) wrap their
+  gradient reductions in when ``TrainConfig.async_collectives`` is on.
+  The tag propagates into the compiled HLO's ``metadata op_name`` on
+  every all-reduce it covers — on ANY backend, including the CPU CI —
+  which is what lets ``analysis/hlo_audit.py``'s ``async-collective``
+  rule prove the builders tagged their reductions without needing a TPU
+  to witness the start/done split itself.
+* :func:`tagged_pmean` / :func:`overlap_scope` — the tagging helpers.
+* :data:`XLA_TPU_FLAGS` — the ``LIBTPU_INIT_ARGS``/``XLA_FLAGS``
+  strings a TPU fleet sets so the tagged reductions actually lower to
+  start/done pairs (docs/ORCHESTRATION.md). They are **data**, not
+  applied here: the CPU backend rejects them as unknown options, so the
+  launcher decides (``scripts/launch_tpu.sh`` exports them; a CPU run
+  never sees them).
+
+The audit story mirrors the donation/accum audits: the invariant is
+checked where it is *provable* on the current backend. CPU proves the
+tag; a TPU build additionally proves every ``all-reduce-start`` has a
+matching ``-done`` with real compute scheduled between them
+(``analysis/hlo_audit.py::async-collective``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax import lax
+
+# The named-scope tag wrapped around gradient/activation reductions.
+# hlo_audit greps compiled HLO metadata for this literal — change it
+# and the audit rule together (they cross-check via this constant).
+OVERLAP_SCOPE = "overlap_allreduce"
+
+# TPU backend flags that turn tagged reductions into async
+# start/done pairs (exported by the launcher, NOT applied in-process;
+# CPU/GPU builds reject the TPU options). Kept as one canonical list so
+# ORCHESTRATION.md and the launch scripts quote the same strings.
+XLA_TPU_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_reduce=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def overlap_scope(enabled: bool = True):
+    """The ``named_scope`` context the step builders wrap reductions in.
+
+    ``enabled=False`` (ASYNC_COLLECTIVES=0) returns a null context —
+    the lowered HLO then carries no tag, which the audit reads as
+    "overlap intentionally off" rather than a missing invariant.
+    """
+    if not enabled:
+        return contextlib.nullcontext()
+    return jax.named_scope(OVERLAP_SCOPE)
+
+
+def tagged_pmean(x, axis_name, *, enabled: bool = True):
+    """``lax.pmean`` under :data:`OVERLAP_SCOPE` (the shard_map/pmap
+    builders' gradient reduction — ``training/sp_step.py``)."""
+    with overlap_scope(enabled):
+        return lax.pmean(x, axis_name)

@@ -51,6 +51,7 @@ from distributeddeeplearning_tpu.parallel.mesh import (
     batch_sharding as _mesh_batch_sharding,
 )
 from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.training import overlap
 from distributeddeeplearning_tpu.training.state import TrainState
 from distributeddeeplearning_tpu.training.train_step import (
     Batch,
@@ -251,9 +252,14 @@ def make_pjit_train_step(
             loss = loss + sown_aux_loss(mutated)
             return loss, (logits, mutated.get("batch_stats", {}))
 
-        (loss, (logits, new_bs)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        # Under GSPMD the gradient all-reduce is implicit in the
+        # backward pass; the overlap tag lands on those reductions so
+        # the TPU async-collective flags can split them into start/done
+        # pairs and hlo_audit can prove the tag (training/overlap.py).
+        with overlap.overlap_scope(cfg.async_collectives):
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
         hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
@@ -332,9 +338,11 @@ def make_pjit_train_step(
                 loss = loss + sown_aux_loss(mutated)
                 return loss, (logits, mutated.get("batch_stats", bs))
 
-            (loss, (logits, new_bs)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
+            # Accum microbatch backward: same overlap tag (see above).
+            with overlap.overlap_scope(cfg.async_collectives):
+                (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params)
             hard = (
                 jnp.argmax(mb_labels, -1)
                 if mb_labels.ndim == logits.ndim

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 import optax
 
 from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.training import overlap
 from distributeddeeplearning_tpu.training.state import TrainState
 from distributeddeeplearning_tpu.training.train_step import (
     cross_entropy_loss,
@@ -122,7 +123,12 @@ def make_sp_train_step(
             return loss, logits
 
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_v)
-        grads = lax.pmean(grads, axes)
+        # Tagged so the TPU async-collective flags can split this into
+        # start/done pairs overlapped with the optimizer math, and so
+        # hlo_audit can prove the tag (training/overlap.py).
+        grads = overlap.tagged_pmean(
+            grads, axes, enabled=cfg.async_collectives
+        )
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
@@ -201,7 +207,10 @@ def make_sp_train_step(
         grads, micro_metrics, _ = accum.accumulate_microbatches(
             micro, xs, accum_steps, params_v, vary=vary
         )
-        grads = lax.pmean(grads, axes)
+        # One tagged reduction on the accumulated mean (see above).
+        grads = overlap.tagged_pmean(
+            grads, axes, enabled=cfg.async_collectives
+        )
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)

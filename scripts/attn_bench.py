@@ -146,13 +146,68 @@ def bench_decode_verify(t: int, k: int, b: int = 1, h: int = 8,
     return row
 
 
+def bench_paged_fused(t: int, b: int = 1, h: int = 8, d: int = 128,
+                      steps: int = 5, block_size: int = 128) -> dict:
+    """One fused paged-decode timing: a [B, 1, H, D] query row against a
+    [num_blocks, block_size, H, D] block pool walked through per-row
+    block tables — the serving tier's SERVE_DECODE_KERNEL=fused hot path
+    (``ops/pallas/paged_decode.py``). Forward-only (a decode kernel has
+    no backward); failures are recorded per row like :func:`bench`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.ops.pallas.paged_decode import (
+        fused_decode_attention,
+    )
+
+    rng = np.random.RandomState(0)
+    bs = min(block_size, t)
+    mb = -(-t // bs)
+    nb = b * mb + 1  # + trash block 0, the pool convention
+    q = jnp.asarray(rng.randn(b, 1, h, d), jnp.bfloat16)
+    k_pool = jnp.asarray(rng.randn(nb, bs, h, d), jnp.bfloat16)
+    v_pool = jnp.asarray(rng.randn(nb, bs, h, d), jnp.bfloat16)
+    # each row owns a contiguous run of blocks (block 0 stays trash)
+    table = jnp.asarray(
+        1 + np.arange(b * mb).reshape(b, mb), jnp.int32
+    )
+    pos = jnp.full((b, 1), t - 1, jnp.int32)  # queries at the tail
+
+    def fwd(q, k_pool, v_pool, pos, table):
+        return fused_decode_attention(
+            q, k_pool, v_pool, pos, block_table=table, block_size=bs,
+        )
+
+    row = {"impl": "paged_fused", "seq_len": t, "batch": b, "heads": h,
+           "head_dim": d, "block_size": bs}
+    try:
+        fn = jax.jit(fwd)
+        out = fn(q, k_pool, v_pool, pos, table)
+        float(jnp.asarray(out).ravel()[0].astype(jnp.float32))  # fence
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, k_pool, v_pool, pos, table)
+        float(jnp.asarray(out).ravel()[0].astype(jnp.float32))
+        ms = (time.perf_counter() - t0) / steps * 1e3
+        row["fwd_ms"] = round(ms, 2)
+        print(f"pgfused T={t:6d} fwd      {ms:9.1f} ms "
+              f"(bs {bs}, {mb} blocks/row)", flush=True)
+    except Exception as e:
+        row["fwd_error"] = f"{type(e).__name__}: {e}"
+        print(f"pgfused T={t:6d} fwd      FAILED: "
+              f"{type(e).__name__}: {e}", flush=True)
+    return row
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seq-lens", default="8192,32768",
                    help="comma-separated sequence lengths")
     p.add_argument("--impls", default="pallas,xla",
                    help="comma-separated attention impls "
-                        "(pallas | xla | auto)")
+                        "(pallas | xla | auto | paged_fused — the "
+                        "serving tier's fused decode kernel, fwd-only)")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
@@ -172,6 +227,12 @@ def main(argv=None) -> int:
     rows, skipped = [], []
     for t in seq_lens:
         for impl in impls:
+            if impl == "paged_fused":
+                rows.append(bench_paged_fused(
+                    t, b=args.batch, h=args.heads, d=args.head_dim,
+                    steps=args.steps,
+                ))
+                continue
             if impl == "xla" and t > XLA_MAX_T and len(impls) > 1:
                 print(f"xla     T={t:6d} skipped "
                       f"([T,T] materialization OOMs)", flush=True)

@@ -18,9 +18,13 @@ re-speculated protocol likewise — records predating the speculative
 tier count as ``spec_k=0``), pairs whose ``data_format`` changed
 (synthetic pool vs streamed shards is a different input pipeline —
 ``data_change`` skip; records predating the streamed tier count as the
-native synthetic reader), and pairs whose ``chaos_plan`` differs (a
+native synthetic reader), pairs whose ``chaos_plan`` differs (a
 fault storm is part of the protocol — ``chaos_change`` skip;
-chaos-free records normalize to no plan).
+chaos-free records normalize to no plan), and pairs whose
+``decode_kernel`` changed (the fused Pallas decode path vs the stitched
+XLA lowering is a different machine program per token —
+``kernel_change`` skip; records predating the kernel tier count as the
+native ``xla`` lowering).
 
 A drop > ``--threshold`` (default 10%) between *consecutive comparable*
 records of the same metric+platform exits nonzero — the CI tripwire
@@ -135,6 +139,12 @@ def analyze(
             # same way (aggregate throughput over N pools is a new
             # baseline); non-fleet records normalize to 1 replica.
             "replicas": int(detail.get("replicas") or 1),
+            # A decode-kernel swap (stitched XLA lowering <-> fused
+            # Pallas paged-decode) replaces the per-token machine
+            # program outright — a new baseline, not a regression.
+            # Records predating the kernel tier carry no field and ran
+            # the native "xla" lowering.
+            "kernel": detail.get("decode_kernel") or "xla",
             # A chaos plan's presence (or a different storm) re-shapes
             # the whole run — faults, rebuilds and brownout windows are
             # part of the protocol, not noise around it — so any
@@ -163,6 +173,7 @@ def analyze(
                 and prev["platform"] == row["platform"]
                 and prev["dtypes"] == row["dtypes"]
                 and prev["spec_k"] == row["spec_k"]
+                and prev["kernel"] == row["kernel"]
                 and prev["replicas"] == row["replicas"]
                 and prev["world"] == row["world"]
                 and prev["data_format"] == row["data_format"]
@@ -192,6 +203,10 @@ def analyze(
                 row["skip"] = (
                     f"spec_change:k={prev['spec_k']}->k={row['spec_k']}"
                 )
+            elif prev is not None and prev["kernel"] != row["kernel"]:
+                row["skip"] = (
+                    f"kernel_change:{prev['kernel']}->{row['kernel']}"
+                )
             elif prev is not None and prev["replicas"] != row["replicas"]:
                 row["skip"] = (
                     f"replica_change:{prev['replicas']}"
@@ -220,7 +235,8 @@ def analyze(
                 last[metric] = {
                     "round": e["round"], "value": value,
                     "platform": row["platform"], "dtypes": row["dtypes"],
-                    "spec_k": row["spec_k"], "replicas": row["replicas"],
+                    "spec_k": row["spec_k"], "kernel": row["kernel"],
+                    "replicas": row["replicas"],
                     "world": row["world"],
                     "data_format": row["data_format"],
                     "chaos": row["chaos"],

@@ -55,12 +55,27 @@ claiming the bf16 floor with int8 bytes would overstate
 ``SlotEngine`` decode loop (``inference.generate`` has no quantized
 path — the serving engine is the product surface for it).
 
+**Kernel compare** (``--kernel xla|fused|both``, the serving tier's
+``SERVE_DECODE_KERNEL`` — docs/SERVING.md): ``fused`` measures through
+the Pallas online-softmax decode kernel
+(``ops/pallas/paged_decode.py``); ``both`` emits one row per kernel per
+batch so the impls are compared against the SAME analytic floor basis.
+The per-kernel bytes are itemized honestly: under a quantized cache the
+stitched (xla) path materialises full-length compute-dtype K/V buffers
+— the gather→dequant round-trip the fused kernel performs in-register —
+charged to the xla rows as ``dequant_roundtrip_bytes`` (write + read of
+both tensors). The fused rows never pay it, which is exactly the
+bytes/step gap serve_bench's compare gate asserts. ``pct_of_floor``
+stays ``None`` off-TPU for every kernel (CPU interpret-mode measures
+dispatch correctness, not roofline position).
+
 Usage::
 
     python scripts/decode_audit.py [--model lm_small] [--prompt-len 128]
         [--new-tokens 128] [--batches 1,2,4,8,16,32,64]
         [--kv-layout dense|paged] [--block-size 16]
-        [--kv-dtype bf16|int8] [--weight-dtype bf16|int8]
+        [--kv-dtype bf16|int8|fp8] [--weight-dtype bf16|int8|fp8]
+        [--kernel xla|fused|both]
         [--spec-k 4] [--spec-draft int8|ngram]
         [--profile-dir /tmp/decode_trace]
 
@@ -179,13 +194,14 @@ def paged_step_bytes(model, b: int, max_len: int, block_size: int,
 def measure_engine(model, params, b: int, prompt_len: int, new_tokens: int,
                    vocab: int, reps: int = 3, *, kv_layout: str = "dense",
                    block_size: int = 16, kv_dtype: str = "bf16",
-                   weight_dtype: str = "bf16") -> float:
+                   weight_dtype: str = "bf16",
+                   decode_kernel: str = "xla") -> float:
     """Measured engine-decode throughput: ``b`` requests co-resident in
-    a SlotEngine (dense or block-pool layout, native or int8 dtypes),
-    timing the batched decode steps (the path the byte floor describes;
-    prefill is the one-off outside it). The quantized configurations
-    only exist on this path — ``inference.generate`` stays
-    native-dtype."""
+    a SlotEngine (dense or block-pool layout, native or quantized
+    dtypes, stitched or fused decode kernel), timing the batched decode
+    steps (the path the byte floor describes; prefill is the one-off
+    outside it). The quantized/fused configurations only exist on this
+    path — ``inference.generate`` stays native-dtype XLA."""
     from distributeddeeplearning_tpu.serving import ReqSpec, SlotEngine
 
     max_len = prompt_len + new_tokens
@@ -196,7 +212,8 @@ def measure_engine(model, params, b: int, prompt_len: int, new_tokens: int,
     engine = SlotEngine(
         model, params, num_slots=b, max_len=max_len,
         buckets=(prompt_len,), kv_layout=kv_layout,
-        kv_dtype=kv_dtype, weight_dtype=weight_dtype, **paged_kw,
+        kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+        decode_kernel=decode_kernel, **paged_kw,
     )
     engine.warmup()
     rng = np.random.RandomState(0)
@@ -230,7 +247,8 @@ def measure_engine(model, params, b: int, prompt_len: int, new_tokens: int,
 def measure_engine_spec(model, params, b: int, prompt_len: int,
                         new_tokens: int, vocab: int, reps: int = 3, *,
                         spec_k: int = 4, spec_draft: str = "int8",
-                        kv_dtype: str = "bf16"):
+                        kv_dtype: str = "bf16",
+                        decode_kernel: str = "xla"):
     """Measured speculative throughput: ``b`` greedy requests
     co-resident in a spec SlotEngine, timing the draft+verify ticks to
     completion. Returns ``(tokens/sec, accept_rate, commits_per_verify)``
@@ -242,6 +260,7 @@ def measure_engine_spec(model, params, b: int, prompt_len: int,
     engine = SlotEngine(
         model, params, num_slots=b, max_len=max_len,
         buckets=(prompt_len,), kv_dtype=kv_dtype,
+        decode_kernel=decode_kernel,
         spec_k=spec_k, spec_draft=spec_draft,
     )
     engine.warmup()
@@ -283,6 +302,7 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
           batches, profile_dir=None, vocab: int = 32000,
           kv_layout: str = "dense", block_size: int = 16,
           kv_dtype: str = "bf16", weight_dtype: str = "bf16",
+          kernel: str = "xla",
           spec_k: int = 0, spec_draft: str = "int8"):
     import flax.linen as nn
     import jax
@@ -341,7 +361,32 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
                 kv += n
         return kv, scale
 
-    quantized = kv_dtype == "int8" or weight_dtype == "int8"
+    quantized = kv_dtype != "bf16" or weight_dtype != "bf16"
+    kernels = ("xla", "fused") if kernel == "both" else (kernel,)
+
+    def native_kv_bytes(b: int) -> int:
+        """Full-length K/V bytes in the COMPUTE dtype for batch ``b`` —
+        the dequantized buffers the stitched kernel materialises under a
+        quantized cache (shape-only; the fused kernel never builds
+        them)."""
+        if kv_layout == "paged":
+            return paged_step_bytes(model, b, max_len, block_size,
+                                    "bf16")[0]
+        native_model = decode_variant(model)
+        shapes = jax.eval_shape(
+            lambda r: native_model.init(
+                r, jnp.zeros((b, max_len), jnp.int32), train=False
+            ),
+            jax.random.PRNGKey(0),
+        )["cache"]
+        return sum(
+            math.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+            for path, leaf in traverse_util.flatten_dict(
+                dict(shapes)
+            ).items()
+            if path[-1] in ("cached_k", "cached_v")
+        )
+
     rows = []
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -389,6 +434,7 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
             tps, accept_rate, commits = measure_engine_spec(
                 model, params, b, prompt_len, new_tokens, vocab,
                 spec_k=spec_k, spec_draft=spec_draft, kv_dtype=kv_dtype,
+                decode_kernel=kernels[0],
             )
             commits = max(commits, 1e-9)
             floor = b * commits * HBM_GBPS * 1e9 / bytes_per_tick
@@ -397,6 +443,7 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
             row = sweep_row(b, tps, kv, bytes_per_tick, floor, on_tpu,
                             kv_scale_bytes=scale_bytes)
             row.update({
+                "kernel": kernels[0],
                 "spec_k": spec_k,
                 "accept_rate": round(accept_rate, 4),
                 "commits_per_verify": round(commits, 2),
@@ -414,28 +461,50 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
             print(format_row(row) + f"  x{row['floor_multiplier']:.2f} "
                   f"floor (accept {accept_rate:.2f})", flush=True)
             continue
-        if kv_layout == "paged":
-            kv, table_bytes, scale_bytes = paged_step_bytes(
-                model, b, max_len, block_size, kv_dtype
+        # The engine path serves paged layouts, quantized dtypes AND any
+        # non-default kernel (inference.generate has none of the three —
+        # the serving engine is the product surface for them).
+        use_engine = (
+            kv_layout == "paged" or quantized or kernels != ("xla",)
+        )
+        if use_engine:
+            if kv_layout == "paged":
+                kv, table_bytes, scale_bytes = paged_step_bytes(
+                    model, b, max_len, block_size, kv_dtype
+                )
+            else:
+                kv, scale_bytes = cache_byte_split(b)
+            base_bytes = param_bytes + kv + scale_bytes + table_bytes
+            dequant_extra = (
+                2 * native_kv_bytes(b) if kv_dtype != "bf16" else 0
             )
-            bytes_per_step = param_bytes + kv + scale_bytes + table_bytes
-            floor = b * HBM_GBPS * 1e9 / bytes_per_step
-            tps = measure_engine(
-                model, params, b, prompt_len, new_tokens, vocab,
-                kv_layout="paged", block_size=block_size,
-                kv_dtype=kv_dtype, weight_dtype=weight_dtype,
-            )
-        elif quantized:
-            kv, scale_bytes = cache_byte_split(b)
-            bytes_per_step = param_bytes + kv + scale_bytes
-            floor = b * HBM_GBPS * 1e9 / bytes_per_step
-            # generate() has no quantized path — measure the batched
-            # decode loop of a real quantized engine (the serving
-            # tier's product surface for these dtypes).
-            tps = measure_engine(
-                model, params, b, prompt_len, new_tokens, vocab,
-                kv_dtype=kv_dtype, weight_dtype=weight_dtype,
-            )
+            for kern in kernels:
+                # Stitched kernel under a quantized cache: the gather
+                # dequantizes full-length K/V into compute-dtype HBM
+                # buffers (write) the score math reads back (read) —
+                # traffic the fused kernel does in-register. Charged to
+                # the xla rows, itemized; the fused floor is the bare
+                # pool stream.
+                extra = dequant_extra if kern == "xla" else 0
+                bytes_per_step = base_bytes + extra
+                floor = b * HBM_GBPS * 1e9 / bytes_per_step
+                tps = measure_engine(
+                    model, params, b, prompt_len, new_tokens, vocab,
+                    kv_layout=kv_layout, block_size=block_size,
+                    kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                    decode_kernel=kern,
+                )
+                row = sweep_row(
+                    b, tps, kv, bytes_per_step, floor, on_tpu,
+                    table_bytes=table_bytes, kv_scale_bytes=scale_bytes,
+                )
+                row["kernel"] = kern
+                if extra:
+                    row["dequant_roundtrip_bytes"] = int(extra)
+                rows.append(row)
+                suffix = f"  [{kern}]" if len(kernels) > 1 else ""
+                print(format_row(row) + suffix, flush=True)
+            continue
         else:
             kv, _ = cache_byte_split(b)
             bytes_per_step = param_bytes + kv
@@ -473,6 +542,7 @@ def audit(model_name: str, prompt_len: int, new_tokens: int,
         "kv_layout": kv_layout,
         "kv_dtype": kv_dtype,
         "weight_dtype": weight_dtype,
+        "decode_kernel": kernel,
         "param_bytes_mb": round(param_bytes / 2**20, 1),
         "hbm_gbps": HBM_GBPS,
         "floor_basis": FLOOR_BASIS,
@@ -505,9 +575,15 @@ def main(argv=None) -> int:
     p.add_argument("--kv-layout", choices=("dense", "paged"),
                    default="dense")
     p.add_argument("--block-size", type=int, default=16)
-    p.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16")
-    p.add_argument("--weight-dtype", choices=("bf16", "int8"),
+    p.add_argument("--kv-dtype", choices=("bf16", "int8", "fp8"),
                    default="bf16")
+    p.add_argument("--weight-dtype", choices=("bf16", "int8", "fp8"),
+                   default="bf16")
+    p.add_argument("--kernel", choices=("xla", "fused", "both"),
+                   default="xla",
+                   help="decode attention lowering to audit "
+                        "(SERVE_DECODE_KERNEL); 'both' emits one row "
+                        "per kernel per batch for the compare gate")
     p.add_argument("--spec-k", type=int, default=0,
                    help="speculative lookahead (0 = off); rows become "
                         "bytes per ACCEPTED token at the measured "
@@ -517,14 +593,18 @@ def main(argv=None) -> int:
     p.add_argument("--profile-dir", default=None)
     args = p.parse_args(argv)
     if args.spec_k and (args.kv_layout == "paged"
-                        or args.weight_dtype == "int8"):
+                        or args.weight_dtype != "bf16"):
         p.error("--spec-k rows audit the dense native-weight engine "
                 "(the serving tier's spec-compare regime)")
+    if args.spec_k and args.kernel == "both":
+        p.error("--spec-k audits one kernel per run "
+                "(--kernel xla or --kernel fused)")
     batches = [int(b) for b in args.batches.split(",") if b.strip()]
     out = audit(args.model, args.prompt_len, args.new_tokens, batches,
                 profile_dir=args.profile_dir, vocab=args.vocab,
                 kv_layout=args.kv_layout, block_size=args.block_size,
                 kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+                kernel=args.kernel,
                 spec_k=args.spec_k, spec_draft=args.spec_draft)
     print(json.dumps(out))
     return 0

@@ -204,6 +204,10 @@ PROTOCOLS = {
 _PROTOCOL_VARS = (
     "BENCH_MODEL", "BENCH_BATCH", "BENCH_SEQ_LEN", "BENCH_DECODE",
     "BENCH_DEPTH", "BENCH_IMAGE_SIZE", "BENCH_SCALING", "ACCUM_STEPS",
+    # Overlap toggle (training/overlap.py): an ambient
+    # ASYNC_COLLECTIVES=0 would silently re-lower every train row's
+    # gradient all-reduces without the overlap tag.
+    "ASYNC_COLLECTIVES",
     # Decode-row geometry + the profile-capture dir (a leaked
     # BENCH_PROFILE would trace-capture every row's measured region).
     "BENCH_PROMPT_LEN", "BENCH_NEW_TOKENS", "BENCH_PROFILE",
@@ -212,7 +216,8 @@ _PROTOCOL_VARS = (
     "SERVE_DEADLINE_MS", "SERVE_PREFILLS_PER_STEP", "SERVE_TOP_K_CAP",
     "SERVE_KV_LAYOUT", "SERVE_PROFILE", "SERVE_BLOCK_SIZE",
     "SERVE_NUM_BLOCKS", "SERVE_PREFIX_CACHE", "SERVE_POOL_SLOT_BUDGET",
-    "SERVE_KV_DTYPE", "SERVE_WEIGHT_DTYPE", "SERVE_QUANT_MATCH_MIN",
+    "SERVE_KV_DTYPE", "SERVE_WEIGHT_DTYPE", "SERVE_DECODE_KERNEL",
+    "SERVE_QUANT_MATCH_MIN",
     "SERVE_SPEC_K", "SERVE_SPEC_DRAFT", "SERVE_SPEC_NGRAM_N",
     "SERVE_SPEC_MIN_SPEEDUP",
     # Telemetry-feedback knobs (docs/SERVING.md adaptive admission): an

@@ -23,11 +23,11 @@ requests is served by up to three configurations:
   non-zero unless paged reaches ≥2× dense peak concurrency (or ≥1.5×
   tokens/sec) with bitwise per-request parity and zero mid-measure
   recompiles on BOTH engines.
-* **quantization compare** (``SERVE_KV_DTYPE=int8`` and/or
-  ``SERVE_WEIGHT_DTYPE=int8`` — docs/SERVING.md): the bf16 (native)
-  engine at ``SERVE_POOL_SLOT_BUDGET`` dense slots vs the quantized
-  engine given the SAME KV-pool bytes — int8 + scales pack ~2–3.5× the
-  slots into the budget, so the quantized engine's capacity (and, with
+* **quantization compare** (``SERVE_KV_DTYPE=int8|fp8`` and/or
+  ``SERVE_WEIGHT_DTYPE=int8|fp8`` — docs/SERVING.md): the bf16
+  (native) engine at ``SERVE_POOL_SLOT_BUDGET`` dense slots vs the
+  quantized engine given the SAME KV-pool bytes — the 1-byte store
+  tiers + scales pack ~2–3.5× the slots into the budget, so the quantized engine's capacity (and, with
   the per-step cost amortized over more co-resident requests, its
   tokens/sec) certifies the byte win. The load runs GREEDY; exact
   parity is mathematically unavailable under quantization (one flipped
@@ -60,8 +60,12 @@ all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail),
 ``SERVE_KV_LAYOUT`` (dense | paged | compare), ``SERVE_BLOCK_SIZE``
 (16), ``SERVE_NUM_BLOCKS`` (0 = dense-equivalent),
 ``SERVE_POOL_SLOT_BUDGET`` (4 — the fixed byte budget, in dense slots),
-``SERVE_KV_DTYPE`` / ``SERVE_WEIGHT_DTYPE`` (bf16 — int8 selects the
-quantization compare), ``SERVE_QUANT_MATCH_MIN`` (0.95),
+``SERVE_KV_DTYPE`` / ``SERVE_WEIGHT_DTYPE`` (bf16 — int8/fp8 selects
+the quantization compare; fp8 falls back to int8 off-TPU),
+``SERVE_DECODE_KERNEL`` (xla — fused selects the Pallas paged-decode
+kernel on every engine the run builds; threaded into the archived
+record as ``detail.decode_kernel`` so bench_trend treats a kernel swap
+as a protocol change), ``SERVE_QUANT_MATCH_MIN`` (0.95),
 ``SERVE_SPEC_K`` (0 — >0 selects the speculative compare),
 ``SERVE_SPEC_DRAFT`` (int8 | ngram), ``SERVE_SPEC_NGRAM_N`` (3),
 ``SERVE_SPEC_MIN_SPEEDUP`` (1.4),
@@ -359,6 +363,7 @@ def run_quant_compare(model, params, reqs, cfg, metric, *, budget_slots,
         model, params, reqs, None,
         engine_kwargs=dict(
             num_slots=budget_slots, max_len=max_len, buckets=cfg.buckets,
+            decode_kernel=cfg.decode_kernel,
         ),
         **common,
     )
@@ -370,6 +375,7 @@ def run_quant_compare(model, params, reqs, cfg, metric, *, budget_slots,
         engine_kwargs=dict(
             num_slots=slots_q, max_len=max_len, buckets=cfg.buckets,
             kv_dtype=cfg.kv_dtype, weight_dtype=cfg.weight_dtype,
+            decode_kernel=cfg.decode_kernel,
         ),
         **common,
     )
@@ -380,8 +386,12 @@ def run_quant_compare(model, params, reqs, cfg, metric, *, budget_slots,
     free_match = positional_match(ref_streams, q_streams)
     logit_err = (
         weight_logit_err(model, params, reqs, ref_streams)
-        if cfg.weight_dtype == "int8" else None
+        if cfg.weight_dtype != "bf16" else None
     )
+    # Label the quantized side by its actual tier (int8 or fp8) so the
+    # archived record says what ran; the kv tier names the engine when
+    # both tiers are set.
+    qlabel = cfg.kv_dtype if cfg.kv_dtype != "bf16" else cfg.weight_dtype
     tps_ratio = (
         q_run["tokens_per_sec"] / ref_run["tokens_per_sec"]
         if ref_run["tokens_per_sec"] else 0.0
@@ -399,18 +409,19 @@ def run_quant_compare(model, params, reqs, cfg, metric, *, budget_slots,
         "platform": jax.devices()[0].platform,
         "kv_dtype": cfg.kv_dtype,
         "weight_dtype": cfg.weight_dtype,
+        "decode_kernel": cfg.decode_kernel,
         "pool_budget_slots": budget_slots,
-        "kv_slot_bytes": {"bf16": int(native_b), "int8": int(quant_b)},
+        "kv_slot_bytes": {"bf16": int(native_b), qlabel: int(quant_b)},
         "kv_bytes_per_token": {
             "bf16": ref_engine.byte_accounting()["kv_bytes_per_token"],
-            "int8": q_engine.byte_accounting()["kv_bytes_per_token"],
+            qlabel: q_engine.byte_accounting()["kv_bytes_per_token"],
         },
         "param_bytes": {
             "bf16": ref_engine.byte_accounting()["param_bytes"],
-            "int8": q_engine.byte_accounting()["param_bytes"],
+            qlabel: q_engine.byte_accounting()["param_bytes"],
         },
         "bf16": ref_run,
-        "int8": q_run,
+        qlabel: q_run,
         "tps_ratio": round(tps_ratio, 2),
         "capacity_ratio": round(capacity_ratio, 2),
         # Teacher-forced per-step agreement (GATED) vs free-running
@@ -468,6 +479,7 @@ def run_spec_compare(model, params, reqs, cfg, metric, *, max_len,
     )
     base_kwargs = dict(
         num_slots=cfg.num_slots, max_len=max_len, buckets=cfg.buckets,
+        decode_kernel=cfg.decode_kernel,
     )
     ref_run, ref_streams, ref_engine = serve_one_engine(
         model, params, reqs, None, engine_kwargs=base_kwargs, **common,
@@ -495,6 +507,7 @@ def run_spec_compare(model, params, reqs, cfg, metric, *, max_len,
         "platform": jax.devices()[0].platform,
         "spec_k": cfg.spec_k,
         "spec_draft": cfg.spec_draft,
+        "decode_kernel": cfg.decode_kernel,
         "greedy": ref_run,
         "spec": spec_run,
         "speedup": round(speedup, 2),
@@ -621,11 +634,11 @@ def main() -> int:
     # Quantization compare (SERVE_KV_DTYPE / SERVE_WEIGHT_DTYPE=int8):
     # its own mode — greedy load (the match-rate oracle's regime),
     # engine-vs-engine at a fixed KV-pool byte budget.
-    quant = cfg.kv_dtype == "int8" or cfg.weight_dtype == "int8"
+    quant = cfg.kv_dtype != "bf16" or cfg.weight_dtype != "bf16"
     if quant and layout != "dense":
         raise SystemExit(
             "the quantization compare runs on the dense layout — unset "
-            "SERVE_KV_LAYOUT or the int8 dtypes"
+            "SERVE_KV_LAYOUT or the quantized (int8/fp8) dtypes"
         )
     # Speculative compare (SERVE_SPEC_K > 0): greedy-vs-speculative,
     # bitwise greedy parity gated (docs/SERVING.md).
@@ -633,7 +646,7 @@ def main() -> int:
     if spec and (quant or layout != "dense"):
         raise SystemExit(
             "the speculative compare runs on the dense native-dtype "
-            "engines — unset SERVE_KV_LAYOUT / the int8 dtypes or "
+            "engines — unset SERVE_KV_LAYOUT / the quantized dtypes or "
             "SERVE_SPEC_K"
         )
     match_min = float(env.get("SERVE_QUANT_MATCH_MIN", "0.95"))
@@ -685,6 +698,7 @@ def main() -> int:
         budget_tokens = budget_slots * max_len
         paged_kwargs = dict(
             num_slots=cfg.num_slots, max_len=max_len, buckets=cfg.buckets,
+            decode_kernel=cfg.decode_kernel,
             kv_layout="paged", block_size=cfg.block_size,
             num_blocks=(
                 cfg.num_blocks or budget_tokens // cfg.block_size + 1
@@ -701,6 +715,7 @@ def main() -> int:
                         else cfg.num_slots
                     ),
                     max_len=max_len, buckets=cfg.buckets,
+                    decode_kernel=cfg.decode_kernel,
                 ),
                 queue_depth=cfg.queue_depth,
                 prefills_per_step=cfg.prefills_per_step,
@@ -726,6 +741,7 @@ def main() -> int:
             "sequential_tokens_per_sec": round(seq_tps, 1),
             "sequential_compiled_shapes": seq_shapes,
             "platform": jax.devices()[0].platform,
+            "decode_kernel": cfg.decode_kernel,
         }
         parity = all(r["parity"] for r in runs.values())
         clean = all(r["compiles_during_measure"] == 0 for r in runs.values())

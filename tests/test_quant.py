@@ -1,5 +1,5 @@
-"""Oracles for ``ops/quant.py`` — the int8 primitive the quantized
-decode tier stands on.
+"""Oracles for ``ops/quant.py`` — the int8/fp8 primitives the
+quantized decode tiers stand on.
 
 What must hold (and is pinned here, CPU tier):
 
@@ -23,6 +23,11 @@ What must hold (and is pinned here, CPU tier):
   logit error the serve_bench quality oracle documents (exact parity is
   mathematically unavailable under quantization; the bound is the
   contract instead, like the accum ULP note).
+* **fp8 tier** (e4m3fn payload, ``SERVE_*_DTYPE=fp8``): the same scale
+  contract at float rounding — per-slice round-trip bounds, extreme
+  values kept finite (e4m3fn has no inf; overflow would round to NaN,
+  not saturate), registry dispatch, the backend support probe, and the
+  ``_qf8``-marker param-tree pass with honest byte splits.
 """
 
 import jax
@@ -184,3 +189,101 @@ def test_full_forward_logit_error_bound(lm_and_params):
         ref.astype(jnp.float32) - got.astype(jnp.float32)
     )))
     assert err < 0.05
+
+
+# ---------------------------------------------------------------------------
+# fp8 tier (e4m3 payload, same scale contract as int8)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fp8_roundtrip_error_bound_per_dtype(dtype):
+    """e4m3fn carries 3 mantissa bits: after the amax/448 scaling every
+    normal value reconstructs within 2^-4 relative; near-zero values
+    within half a subnormal step of the scaled grid. The bound is per
+    element from the slice's own scale — same shape contract as int8."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(16, 64) * 3.0, dtype)
+    q, scale = quantlib.quantize_fp8(x, axis=-1)
+    assert q.dtype == jnp.float8_e4m3fn and scale.dtype == jnp.float32
+    assert q.shape == x.shape and scale.shape == (16, 1)
+    dq = quantlib.dequantize_fp8(q, scale, jnp.float32)
+    xf = np.asarray(x, np.float32)
+    err = np.abs(xf - np.asarray(dq))
+    sc = np.asarray(scale)
+    bound = np.maximum(np.abs(xf) * 2.0 ** -4, sc * 2.0 ** -10) + 1e-9
+    assert (err <= bound).all()
+
+
+def test_fp8_extreme_values_stay_finite_and_exact():
+    # all-zero slices: scale 1, exact zero reconstruction (no NaN)
+    z = jnp.zeros((4, 8), jnp.float32)
+    qz, sz = quantlib.quantize_fp8(z, axis=-1)
+    assert np.array_equal(np.asarray(sz), np.ones((4, 1), np.float32))
+    assert np.array_equal(
+        np.asarray(quantlib.dequantize_fp8(qz, sz)),
+        np.zeros((4, 8), np.float32),
+    )
+    # the amax element maps exactly onto ±fmax (448 for e4m3fn) and
+    # reconstructs exactly; e4m3fn has no inf, so the pre-clip is what
+    # keeps an overflow from rounding to NaN
+    y = jnp.asarray([[1e30, -1e30, 1e-30, 0.25]], jnp.float32)
+    qy, sy = quantlib.quantize_fp8(y, axis=-1)
+    qf = np.asarray(qy, np.float32)
+    assert np.isfinite(qf).all()
+    fmax = float(jnp.finfo(jnp.float8_e4m3fn).max)
+    assert qf.max() == fmax and qf.min() == -fmax
+    dy = np.asarray(quantlib.dequantize_fp8(qy, sy))
+    assert np.isfinite(dy).all()
+    np.testing.assert_allclose(dy[0, 0], 1e30, rtol=1e-6)
+    # e5m2 (the wider-exponent KV option) honors the same contract
+    q5, s5 = quantlib.quantize_fp8(y, axis=-1, dtype=jnp.float8_e5m2)
+    assert q5.dtype == jnp.float8_e5m2
+    assert np.isfinite(np.asarray(q5, np.float32)).all()
+
+
+def test_fp8_registry_dispatch_and_support_probe():
+    assert quantlib.kv_store_dtype("fp8") == quantlib.FP8_KV_DTYPE
+    assert quantlib.kv_store_dtype("int8") == jnp.int8
+    assert quantlib.kv_store_dtype("bf16") is None
+    q, s = quantlib.quantize_kv(jnp.ones((2, 4)), "fp8")
+    assert q.dtype == quantlib.FP8_KV_DTYPE
+    with pytest.raises(ValueError, match="kv_dtype"):
+        quantlib.validate_store_dtype("kv_dtype", "int4")
+    # CPU executes fp8 casts: the probe must say so (the TPU-gated
+    # fallback path is exercised by monkeypatching in serving tests)
+    assert quantlib.fp8_supported() is True
+
+
+def test_param_tree_fp8_pass_markers_and_bytes(lm_and_params):
+    model, params = lm_and_params
+    qtree = quantlib.quantize_params(params, dtype="fp8")
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(qtree)
+    markers = {p[-1] for p in flat}
+    assert quantlib.QF8 in markers and quantlib.QF8_SCALE in markers
+    assert quantlib.Q8 not in markers
+    assert quantlib.is_quantized(qtree)
+    split = quantlib.tree_byte_split(qtree)
+    native = quantlib.tree_byte_split(params)
+    assert split["fp8"] > 0 and split["int8"] == 0
+    assert quantlib.quantized_bytes(split) == split["fp8"]
+    # payload + scales + passthrough strictly below the f32 original
+    assert sum(split.values()) < sum(native.values())
+    # mixing tiers is still one-shot
+    with pytest.raises(ValueError, match="already quantized"):
+        quantlib.quantize_params(qtree, dtype="fp8")
+    # dequant restores every leaf's shape; per-slice error bound holds
+    dq = quantlib.dequantize_params(qtree)
+    dflat = traverse_util.flatten_dict(dq)
+    pflat = traverse_util.flatten_dict(params)
+    assert set(dflat) == set(pflat)
+    for path, leaf in pflat.items():
+        if not quantlib._is_quantizable(path, leaf):
+            continue
+        axis = quantlib._quant_axis(path)
+        ref = np.asarray(leaf, np.float32)
+        got = np.asarray(dflat[path], np.float32)
+        amax = np.abs(ref).max(axis=axis, keepdims=True)
+        assert (np.abs(ref - got) <= amax * 2.0 ** -4 + 1e-9).all(), path

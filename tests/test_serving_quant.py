@@ -1,4 +1,5 @@
-"""Quantized decode tier oracles (kv_dtype / weight_dtype = "int8").
+"""Quantized decode tier oracles (kv_dtype / weight_dtype = "int8" /
+"fp8" — plus the fused-kernel env surface they share).
 
 The quantized tier's contract, pinned here (CPU tier):
 
@@ -309,3 +310,43 @@ def test_serve_config_quant_env_and_kwargs():
             TransformerLM(variant="tiny", vocab_size=8, max_seq_len=8),
             {}, weight_dtype="fp4",
         )
+
+
+def test_serve_config_kernel_and_fp8_env_surface():
+    """The round-10 knobs ride the same registry: fp8 parses as a real
+    tier, SERVE_DECODE_KERNEL threads into engine_kwargs, and unknown
+    values fail naming the supported list (not an int8 special case)."""
+    cfg = ServeConfig.from_env({
+        "SERVE_KV_DTYPE": "fp8", "SERVE_WEIGHT_DTYPE": "fp8",
+        "SERVE_DECODE_KERNEL": "fused",
+    })
+    assert cfg.kv_dtype == "fp8" and cfg.weight_dtype == "fp8"
+    assert cfg.decode_kernel == "fused"
+    kw = cfg.engine_kwargs()
+    assert kw["kv_dtype"] == "fp8" and kw["decode_kernel"] == "fused"
+    assert ServeConfig.from_env({}).decode_kernel == "xla"
+    with pytest.raises(ValueError, match=r"kv_dtype.*bf16.*int8.*fp8"):
+        ServeConfig(kv_dtype="int4").engine_kwargs()
+    with pytest.raises(ValueError, match="SERVE_DECODE_KERNEL"):
+        ServeConfig(decode_kernel="pallas2").engine_kwargs()
+    with pytest.raises(ValueError, match="decode_kernel"):
+        SlotEngine(
+            TransformerLM(variant="tiny", vocab_size=8, max_seq_len=8),
+            {}, decode_kernel="turbo",
+        )
+
+
+def test_fp8_engine_falls_back_to_int8_when_unsupported(
+    model, params, monkeypatch
+):
+    """The platform gate: where the fp8 probe fails (older TPU gens,
+    exotic backends), the engine substitutes int8 — logged, and visible
+    in the stored dtypes / byte accounting rather than silently kept."""
+    from distributeddeeplearning_tpu.ops import quant as quantlib
+
+    monkeypatch.setattr(quantlib, "fp8_supported", lambda: False)
+    eng = SlotEngine(
+        model, params, num_slots=2, max_len=MAX_LEN, buckets=(8,),
+        kv_dtype="fp8", weight_dtype="fp8",
+    )
+    assert eng.kv_dtype == "int8" and eng.weight_dtype == "int8"
