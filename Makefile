@@ -24,7 +24,8 @@ PY                ?= python
 .PHONY: build login push run jupyter smoke test test-fast test-smoke check \
         lint \
         notebooks bench recertify decode-audit heavy-refresh obs-report \
-        obs-watch bench-trend accum-memory fault-suite elastic-drill \
+        obs-watch trace-report bench-trend accum-memory fault-suite \
+        elastic-drill \
         serve-bench serve-bench-spec fleet-bench chaos-bench stream-shards \
         stream-bench native \
         provision setup submit stream status stop teardown
@@ -169,6 +170,11 @@ obs-report:	## event-bus run report for the newest runs/<dir> (docs/OBSERVABILIT
 obs-watch:	## live dashboard for the newest runs/<dir>: rollups + SLO burn
 	## rates, publishes rollup.json (OBS_RUN=dir, SLO_SPEC honored)
 	$(PY) scripts/obs_watch.py $(or $(OBS_RUN),$(shell ls -td runs/*/ 2>/dev/null | head -1))
+
+trace-report:	## per-request critical-path digest for the newest runs/<dir>:
+	## top-K-slowest decomposed per phase vs fleet p50, chaos causes,
+	## orphans, per-step training attribution (OBS_RUN=dir, TOP=K)
+	$(PY) scripts/trace_report.py $(or $(OBS_RUN),$(shell ls -td runs/*/ 2>/dev/null | head -1)) --top $(or $(TOP),5)
 
 bench-trend:	## regression sentinel over BENCH_r*.json: fails on a >10%
 	## like-for-like drop; cpu/outage-tier rounds listed, never compared

@@ -311,6 +311,115 @@ def run_obs_registry() -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# obs-trace-ctx
+# ---------------------------------------------------------------------------
+
+#: The serving hot paths where every per-request emit must carry its
+#: request's trace id (docs/OBSERVABILITY.md trace plane).
+TRACE_HOT_PATHS = (
+    "distributeddeeplearning_tpu/serving/scheduler.py",
+    "distributeddeeplearning_tpu/serving/fleet/router.py",
+)
+
+#: Event-name families whose emit sites must execute under a bound
+#: trace context. Prefix-matched: ``serve.request`` also covers
+#: ``serve.request_done``; ``serve.decode`` covers ``serve.decode_step``
+#: (the shared tick, bound to the server's own tick trace) and
+#: ``serve.decode_share`` (the per-slot attribution span).
+TRACED_FAMILIES = (
+    "serve.request", "serve.prefill", "serve.decode",
+    "serve.queue_wait", "serve.ttft", "serve.delivery",
+)
+
+
+def _binds_trace_ctx(node: ast.With) -> bool:
+    for item in node.items:
+        ce = item.context_expr
+        if isinstance(ce, ast.Call):
+            name = _dotted(ce.func)
+            if name is not None and name.split(".")[-1] == "trace_ctx":
+                return True
+    return False
+
+
+class _NakedTracedEmits(ast.NodeVisitor):
+    """Find traced-family emits with no lexically enclosing
+    ``with ...trace_ctx(...)``. Function boundaries are barriers: a
+    nested ``def``'s body runs later, possibly outside the ``with``, so
+    an outer binding does not cover it."""
+
+    def __init__(self) -> None:
+        self.naked: List[Tuple[str, str, int]] = []
+        self._stack: List[str] = []  # "trace" | "with" | "barrier"
+        self._is_bus = _ObsEmits()._is_bus
+
+    def _covered(self) -> bool:
+        for frame in reversed(self._stack):
+            if frame == "trace":
+                return True
+            if frame == "barrier":
+                return False
+        return False
+
+    def visit_With(self, node: ast.With) -> None:
+        self._stack.append(
+            "trace" if _binds_trace_ctx(node) else "with"
+        )
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_barrier(self, node: ast.AST) -> None:
+        self._stack.append("barrier")
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_barrier
+    visit_AsyncFunctionDef = _visit_barrier
+    visit_Lambda = _visit_barrier
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _EMIT_METHODS
+            and self._is_bus(node.func.value)
+            and node.args
+        ):
+            name = _str_const(node.args[0])
+            if (
+                name is not None
+                and name.startswith(TRACED_FAMILIES)
+                and not self._covered()
+            ):
+                self.naked.append((name, node.func.attr, node.lineno))
+        self.generic_visit(node)
+
+
+@register(
+    "obs-trace-ctx", "contract",
+    "every serve.request/serve.prefill/serve.decode-family emit in the "
+    "serving hot paths executes under a lexically bound obs.trace_ctx, "
+    "so the record carries its request's trace id",
+)
+def run_obs_trace_ctx() -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in TRACE_HOT_PATHS:
+        path = os.path.join(REPO_ROOT, rel)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        v = _NakedTracedEmits()
+        v.visit(ast.parse(src))
+        for name, kind, line in v.naked:
+            findings.append(Finding(
+                "obs-trace-ctx", rel, line,
+                f"{kind} {name!r} is emitted outside any bound trace "
+                f"context — wrap it in `with obs.trace_ctx(...)` so the "
+                f"record carries its request's trace id (the critical-"
+                f"path reconstructor in obs/traces.py keys on it)",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # protocol-vars
 # ---------------------------------------------------------------------------
 

@@ -17,20 +17,27 @@ The **live plane** reads the same files while the run is alive:
 from distributeddeeplearning_tpu.obs.bus import (
     DEFAULT_RING_SIZE,
     EventBus,
+    TraceContext,
     bind_bus,
     bound_bus,
     configure,
     configure_from_env,
     counter,
     current_bus,
+    current_trace,
     flush,
     gauge,
     get_bus,
     install_crash_handlers,
+    new_span_id,
+    new_trace_id,
     point,
     reset,
     span,
     span_event,
+    trace_close,
+    trace_ctx,
+    trace_open,
 )
 from distributeddeeplearning_tpu.obs.rollup import (  # noqa: F401
     LivePlane,
@@ -50,10 +57,12 @@ __all__ = [
     "LivePlane",
     "SloEngine",
     "Tailer",
+    "TraceContext",
     "WindowedAggregator",
     "bind_bus",
     "bound_bus",
     "current_bus",
+    "current_trace",
     "configure",
     "configure_from_env",
     "counter",
@@ -61,11 +70,16 @@ __all__ = [
     "gauge",
     "get_bus",
     "install_crash_handlers",
+    "new_span_id",
+    "new_trace_id",
     "parse_slo_spec",
     "point",
     "read_snapshot",
     "reset",
     "span",
     "span_event",
+    "trace_close",
+    "trace_ctx",
+    "trace_open",
     "write_snapshot",
 ]

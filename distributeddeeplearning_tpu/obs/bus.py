@@ -41,6 +41,15 @@ Schema (one JSON object per line)::
     {"t": ...,           "kind": "gauge",   "name": ..., "value": x, ...}
     {"t": ...,           "kind": "point",   "name": ..., ...}
 
+**Trace context (docs/OBSERVABILITY.md trace plane):** any emit made
+while the calling thread holds a bound :class:`TraceContext`
+(``with bus.trace_ctx(trace_id):`` / ``obs.trace_ctx``) additionally
+carries ``trace``/``span`` (and ``parent``/``cause`` when set) — the
+request-scoped causal identity that survives router → replica → engine
+handoffs. Stamping is a host-side dict assignment; it adds zero host
+syncs and no device work. ``obs/traces.py`` reconstructs per-request
+critical paths from the stamped files.
+
 Knobs (env): ``OBS_DIR`` (run directory; unset = ring-only, no files),
 ``OBS_RUN_ID`` (shared by the launcher so all processes of one world
 agree), ``OBS_RING_SIZE`` (flight-recorder depth, default 512),
@@ -66,6 +75,43 @@ SCHEMA_VERSION = 1
 DEFAULT_RING_SIZE = 512
 _AUTOFLUSH_EVERY = 256
 DEFAULT_FLUSH_EVERY_S = 5.0
+
+
+def new_trace_id() -> str:
+    """A fresh trace id (12 hex chars, host-side entropy only)."""
+    return os.urandom(6).hex()
+
+
+def new_span_id() -> str:
+    """A fresh span id within a trace (8 hex chars)."""
+    return os.urandom(4).hex()
+
+
+class TraceContext:
+    """One thread's trace coordinates: every emit made while a context
+    is bound is stamped with ``trace``/``span`` (+ ``parent``/``cause``
+    when set). Immutable; nesting derives child contexts whose
+    ``parent`` is the enclosing span of the *same* trace — a re-route
+    child span links back to the parent trace causally via ``cause``
+    (``hedge`` | ``splice`` | ``brownout`` | ``migration``)."""
+
+    __slots__ = ("trace", "span", "parent", "cause")
+
+    def __init__(
+        self,
+        trace: str,
+        span: Optional[str] = None,
+        parent: Optional[str] = None,
+        cause: Optional[str] = None,
+    ) -> None:
+        self.trace = str(trace)
+        self.span = str(span) if span else new_span_id()
+        self.parent = parent
+        self.cause = cause
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        extra = f", cause={self.cause!r}" if self.cause else ""
+        return f"TraceContext({self.trace}/{self.span}{extra})"
 
 
 def _flush_every_s_from_env() -> float:
@@ -125,6 +171,11 @@ class EventBus:
         self.ring: collections.deque = collections.deque(maxlen=max(ring_size, 1))
         self._buffer: list = []
         self._seq = 0
+        # In-flight trace registry (trace_open/trace_close): what this
+        # bus's process/replica is holding RIGHT NOW — dumped into the
+        # flight-recorder header so a crash black box names the
+        # requests a dead replica was serving.
+        self._active_traces: Dict[str, Dict[str, Any]] = {}
         self._fh = None
         self.path: Optional[str] = None
         self.meta: Dict[str, Any] = {
@@ -177,6 +228,15 @@ class EventBus:
             rec["dur"] = dur
         if labels:
             rec["labels"] = labels
+        ctx = getattr(_TLS, "trace", None)
+        if ctx is not None:
+            # Host-side dict stamping only — zero new host syncs.
+            rec["trace"] = ctx.trace
+            rec["span"] = ctx.span
+            if ctx.parent:
+                rec["parent"] = ctx.parent
+            if ctx.cause:
+                rec["cause"] = ctx.cause
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq
@@ -225,6 +285,39 @@ class EventBus:
             t = time.monotonic() - dur
         self.emit("span", name, t=t, dur=dur, labels=labels or None)
 
+    # -- trace context -----------------------------------------------------
+
+    def trace_ctx(
+        self,
+        trace: Union["TraceContext", str, None],
+        span: Optional[str] = None,
+        *,
+        parent: Optional[str] = None,
+        cause: Optional[str] = None,
+    ):
+        """Bind a trace context for the calling thread (see the
+        module-level :func:`trace_ctx` — the binding is thread-local,
+        not per-bus, so it rides every bus the thread emits to)."""
+        return trace_ctx(trace, span, parent=parent, cause=cause)
+
+    def trace_open(self, trace_id: str, **info: Any) -> None:
+        """Register ``trace_id`` as in flight on this bus (flight
+        recorder: a crash dump's header names the active traces)."""
+        rec = dict(info)
+        rec["opened_t"] = time.monotonic()
+        with self._lock:
+            self._active_traces[str(trace_id)] = rec
+
+    def trace_close(self, trace_id: str) -> None:
+        """Mark ``trace_id`` no longer held by this bus's process."""
+        with self._lock:
+            self._active_traces.pop(str(trace_id), None)
+
+    def active_traces(self) -> Dict[str, Dict[str, Any]]:
+        """Snapshot of the in-flight trace registry."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._active_traces.items()}
+
     # -- persistence -------------------------------------------------------
 
     def _flush_locked(self) -> None:
@@ -251,6 +344,7 @@ class EventBus:
         next to the cwd so a crash still leaves evidence."""
         with self._lock:
             recs = list(self.ring)
+            active = {k: dict(v) for k, v in self._active_traces.items()}
         if path is None:
             base = self.directory or os.getcwd()
             path = os.path.join(base, f"flight-{_proc_tag(self.proc)}.jsonl")
@@ -259,6 +353,11 @@ class EventBus:
         header["reason"] = reason
         header["dump_wall"] = time.time()
         header["dump_t"] = time.monotonic()
+        if active:
+            # The requests this process was holding at crash time — a
+            # post-mortem joins these trace ids against the fleet's
+            # event files to name what died here.
+            header["active_traces"] = active
         try:
             with open(path, "w") as fh:
                 fh.write(json.dumps(header, default=str) + "\n")
@@ -337,6 +436,47 @@ def bound_bus(bus: Optional[EventBus]) -> Iterator[Optional[EventBus]]:
         yield bus
     finally:
         bind_bus(prev)
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The calling thread's bound trace context (None when untraced)."""
+    return getattr(_TLS, "trace", None)
+
+
+@contextlib.contextmanager
+def trace_ctx(
+    trace: Union[TraceContext, str, None],
+    span: Optional[str] = None,
+    *,
+    parent: Optional[str] = None,
+    cause: Optional[str] = None,
+) -> Iterator[Optional[TraceContext]]:
+    """Scope a thread-local trace context: every emit inside the block
+    (any bus) is stamped with its coordinates; the previous context is
+    restored on exit.
+
+    ``trace`` may be a trace id (a child span id is minted; nesting
+    under the same trace links ``parent`` to the enclosing span), a
+    ready-made :class:`TraceContext` (bound as-is — how a component
+    re-binds a context that crossed a thread boundary on a request
+    object), or ``None`` (passthrough: keeps call sites branch-free
+    for requests that carry no trace). ``cause`` marks causal child
+    spans — a hedge/splice/brownout/migration re-route."""
+    if trace is None:
+        yield getattr(_TLS, "trace", None)
+        return
+    prev = getattr(_TLS, "trace", None)
+    if isinstance(trace, TraceContext):
+        ctx = trace
+    else:
+        if parent is None and prev is not None and prev.trace == str(trace):
+            parent = prev.span
+        ctx = TraceContext(trace, span, parent, cause)
+    _TLS.trace = ctx
+    try:
+        yield ctx
+    finally:
+        _TLS.trace = prev
 
 
 def configure(
@@ -432,6 +572,7 @@ def reset() -> None:
     bus."""
     global _GLOBAL, _handlers_installed, _prev_excepthook, _prev_sigterm
     _TLS.bus = None  # unbind the calling thread (other threads own theirs)
+    _TLS.trace = None  # drop any bound trace context with it
     with _GLOBAL_LOCK:
         if _GLOBAL is not None:
             _GLOBAL.close()
@@ -480,6 +621,14 @@ def span_event(
     name: str, dur: float, t: Optional[float] = None, **labels: Any
 ) -> None:
     current_bus().span_event(name, dur, t=t, **labels)
+
+
+def trace_open(trace_id: str, **info: Any) -> None:
+    current_bus().trace_open(trace_id, **info)
+
+
+def trace_close(trace_id: str) -> None:
+    current_bus().trace_close(trace_id)
 
 
 def flush() -> None:

@@ -324,6 +324,30 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
             "brownout_stage": gauges.get("fleet.brownout_stage"),
         }
 
+    # Trace plane (obs/traces.py): per-request critical paths with gap
+    # accounting, reconstructed from the same merged timeline. Compact
+    # here — `scripts/trace_report.py` renders the full digest.
+    trace_summary = None
+    if any("trace" in e for e in events):
+        try:
+            from distributeddeeplearning_tpu.obs import traces as _traces
+            recon = _traces.reconstruct(events)
+            if recon["count"] or recon["orphan_count"]:
+                p50s = _traces.phase_p50s(recon["requests"])
+                trace_summary = {
+                    "requests": recon["count"],
+                    "orphans": recon["orphan_count"],
+                    "sheds": recon["sheds"],
+                    "within_tolerance": recon["within_tolerance"],
+                    "causes": recon["causes"],
+                    "p50s": p50s,
+                    "top_slow": _traces.top_slow(
+                        recon["requests"], k=3, p50s=p50s
+                    ),
+                }
+        except Exception:
+            trace_summary = None  # report renders even off malformed traces
+
     for entry in slo_by_obj.values():
         entry["timeline"].sort(
             key=lambda e: (e["wall"] is None, e["wall"] or 0.0)
@@ -343,6 +367,7 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         "step_s": step_s,
         "data_plane": data_plane,
         "serving": serving,
+        "traces": trace_summary,
         "slo": slo_by_obj or None,
         "max_epoch_skew_ms": max(skews) if skews else 0.0,
         "epochs_seen": len(epoch_ends),
@@ -511,6 +536,27 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
                     f"total {s['total_s']:8.3f}s  p50 {s['p50_ms']:8.2f}ms  "
                     f"p99 {s['p99_ms']:8.2f}ms"
                 )
+    tr = summary.get("traces")
+    if tr:
+        add("")
+        add("traces (request critical paths, obs/traces.py):")
+        add(
+            f"  {tr['requests']} request(s) reconstructed "
+            f"({tr['within_tolerance']} within gap tolerance, "
+            f"{tr['sheds']} shed), {tr['orphans']} orphan(s)"
+        )
+        if tr.get("causes"):
+            add("  interventions: " + ", ".join(
+                f"{c}x{n}" for c, n in sorted(tr["causes"].items())
+            ))
+        for r in tr.get("top_slow", []):
+            add(
+                f"  slow: req={r.get('req', '?')} "
+                f"e2e {r['e2e_s'] * 1e3:.1f}ms "
+                f"culprit={r['culprit']} "
+                f"(+{r['culprit_excess_s'] * 1e3:.1f}ms vs p50)"
+            )
+        add("  full digest: make trace-report")
     slo = summary.get("slo")
     if slo:
         add("")

@@ -84,7 +84,8 @@ def hist_quantile(counts: Dict[int, int], q: float) -> float:
 class _Slice:
     """Aggregates for one ``slice_s`` sub-window."""
 
-    __slots__ = ("counters", "hists", "span_max", "points", "events")
+    __slots__ = ("counters", "hists", "span_max", "points", "events",
+                 "traces", "reroute_causes")
 
     def __init__(self) -> None:
         self.counters: Dict[str, float] = {}
@@ -92,6 +93,11 @@ class _Slice:
         self.span_max: Dict[str, float] = {}
         self.points: Dict[str, int] = {}
         self.events = 0
+        # Trace plane: distinct request traces touching this slice +
+        # chaos re-route causes (hedge/splice/brownout/migration).
+        # Bounded by in-flight requests per slice, not event count.
+        self.traces: set = set()
+        self.reroute_causes: Dict[str, int] = {}
 
 
 class WindowedAggregator:
@@ -150,6 +156,12 @@ class WindowedAggregator:
             sl = self._slices[key] = _Slice()
             self._expire()
         sl.events += 1
+        tid = event.get("trace")
+        if tid:
+            sl.traces.add(tid)
+        if name == "fleet.reroute" and event.get("cause"):
+            cause = str(event["cause"])
+            sl.reroute_causes[cause] = sl.reroute_causes.get(cause, 0) + 1
         if kind == "counter":
             try:
                 v = float(event.get("value", 1))
@@ -306,6 +318,20 @@ class WindowedAggregator:
             "spans": spans,
             "points": points,
         }
+        # Trace-plane window view: distinct request traces active in
+        # the window + chaos re-routes by cause. Published only when
+        # the stream is actually trace-stamped.
+        trace_ids: set = set()
+        reroutes: Dict[str, int] = {}
+        for sl in slices:
+            trace_ids.update(sl.traces)
+            for cause, n in sl.reroute_causes.items():
+                reroutes[cause] = reroutes.get(cause, 0) + n
+        if trace_ids or reroutes:
+            snap["traces"] = {
+                "distinct": len(trace_ids),
+                "reroutes": dict(sorted(reroutes.items())),
+            }
         # Per-stream gauge view (serving fleet): published only when more
         # than one stream emitted gauges — the single-stream case is
         # exactly the flat `gauges` section already.

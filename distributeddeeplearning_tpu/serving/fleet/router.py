@@ -269,6 +269,15 @@ class FleetHandle:
         self.replica_id: Optional[int] = None
         self.attempts = 0
         self.restart_consistent = True
+        # Trace plane (docs/OBSERVABILITY.md): the fleet mints the
+        # request's causal identity at submission; every attempt's
+        # Request carries it to the replica (Request.trace), and a
+        # re-route stamps its cause (hedge|splice|brownout|migration)
+        # on the child span the next dispatch emits.
+        self.trace = request.trace or obs.new_trace_id()
+        self._reroute_cause: Optional[str] = None
+        self._requeued_t: Optional[float] = None
+        self._reroute_from: Optional[int] = None
         # Splice-integrity ledger (docs/ROBUSTNESS.md serving failure
         # model): every replay mismatch ever seen (the corrupt
         # detector's count — survives healing), the live divergence
@@ -529,7 +538,8 @@ class Router:
         requests re-routed."""
         replica = self._replica(rid)
         replica.begin_drain()
-        return self._requeue_from(replica, running_too=False)
+        return self._requeue_from(replica, running_too=False,
+                                  cause="migration")
 
     def fail_replica(self, rid: int, error: Optional[BaseException] = None
                      ) -> int:
@@ -558,7 +568,7 @@ class Router:
                 error=repr(error) if error else "declared_failed",
                 exit_code=replica.exit_code, retryable=True,
             )
-        return self._requeue_from(replica, running_too=True)
+        return self._requeue_from(replica, running_too=True, cause="splice")
 
     def quarantine_replica(self, rid: int, **labels: Any) -> int:
         """Straggler quarantine: stop placing onto ``rid`` and hedge
@@ -581,7 +591,7 @@ class Router:
         replica.straggle_ticks = 0
         self.stats["quarantined"] += 1
         obs.point("fleet.quarantine", replica=rid, **labels)
-        moved = self._requeue_from(replica, running_too=True)
+        moved = self._requeue_from(replica, running_too=True, cause="hedge")
         replica.resume()
         return moved
 
@@ -665,17 +675,25 @@ class Router:
             attempts=b["attempts"], retryable=replica.retryable,
             exit_code=replica.exit_code,
         )
-        self._requeue_from(replica, running_too=True)
+        self._requeue_from(replica, running_too=True, cause="splice")
         if any(r.rid == replica.rid for r in self.replicas):
             self.remove_replica(replica.rid)
 
-    def _requeue_from(self, replica: Replica, *, running_too: bool) -> int:
+    def _requeue_from(self, replica: Replica, *, running_too: bool,
+                      cause: str = "migration") -> int:
         """Reclaim a replica's requests and put them back at the front
-        of their tenant queues, preserving relative submit order."""
+        of their tenant queues, preserving relative submit order.
+        ``cause`` (hedge|splice|migration) rides each handle to the
+        next dispatch, which emits the re-route child span under the
+        request's trace."""
         subs = replica.reclaim_queued()
         if running_too and replica.server is not None:
-            subs += replica.server.take_running()
+            # The replica's private event stream must see the
+            # trace_close for the running work being taken from it.
+            with obs.bound_bus(replica.bus):
+                subs += replica.server.take_running()
         moved = 0
+        now = time.monotonic()
         with self._lock:
             sub_ids = {id(s) for s in subs}
             victims = [
@@ -686,6 +704,9 @@ class Router:
             for fh in sorted(victims, key=lambda f: f.id, reverse=True):
                 self._inflight.remove(fh)
                 fh._detach()
+                fh._reroute_cause = cause
+                fh._requeued_t = now
+                fh._reroute_from = replica.rid
                 self._tenant(fh.tenant).queue.appendleft(fh)
                 moved += 1
                 self.stats["requeued"] += 1
@@ -718,10 +739,12 @@ class Router:
         if tenant in self._shed_tenants:
             # Brownout shed: a distinct, client-visible outcome — the
             # handle finishes as "brownout" immediately, never a silent
-            # drop and never a generic QueueFull masquerade.
+            # drop and never a generic QueueFull masquerade. The shed
+            # counter is the trace's terminal marker (cause=brownout).
             fh = FleetHandle(request, tenant, next(self._ids), now)
             self.stats["brownout"] += 1
-            obs.counter("serve.brownout_shed", tenant=tenant)
+            with obs.trace_ctx(fh.trace, cause="brownout"):
+                obs.counter("serve.brownout_shed", tenant=tenant)
             fh._finish("brownout")
             return fh
         for r in self.replicas:
@@ -739,7 +762,10 @@ class Router:
             fh = FleetHandle(request, tenant, next(self._ids), now)
             self._tenant(tenant).queue.append(fh)
             self.stats["submitted"] += 1
-        obs.counter("fleet.submitted", tenant=tenant)
+        # The trace's fleet-level admission point (req labels let the
+        # trace reconstructor name the fleet request id).
+        with obs.trace_ctx(fh.trace):
+            obs.counter("fleet.submitted", tenant=tenant, req=fh.id)
         return fh
 
     # -- pump --------------------------------------------------------------
@@ -869,7 +895,8 @@ class Router:
         for fh in divergent:
             rid = fh.replica_id
             self.stats["splice_mismatch"] += 1
-            obs.point("fleet.splice_mismatch", req=fh.id, replica=rid)
+            with obs.trace_ctx(fh.trace, cause="splice"):
+                obs.point("fleet.splice_mismatch", req=fh.id, replica=rid)
             # The delivered prefix is immutable (already streamed); the
             # divergent attempt is the corrupt one. Heal: hard-fault
             # the replica producing it and replay from the prefix.
@@ -884,7 +911,10 @@ class Router:
                 with self._lock:
                     if fh in self._inflight:
                         self._inflight.remove(fh)
+                        fh._reroute_from = fh.replica_id
                         fh._detach()
+                        fh._reroute_cause = "splice"
+                        fh._requeued_t = time.monotonic()
                         self._tenant(fh.tenant).queue.appendleft(fh)
                         self.stats["requeued"] += 1
         for r in list(self.replicas):
@@ -919,7 +949,7 @@ class Router:
                 and (r.server.queued_count or r.server.active_count)
             ):
                 # the pump is dead: reclaim everything it held
-                self._requeue_from(r, running_too=True)
+                self._requeue_from(r, running_too=True, cause="splice")
 
     def _finish_sweep(self) -> None:
         with self._lock:
@@ -948,11 +978,12 @@ class Router:
                 t.completed += 1
                 t.tokens_done += len(fh.new_tokens)
                 self.stats["completed"] += 1
-                obs.counter("fleet.completed", tenant=fh.tenant)
-                obs.counter(
-                    "fleet.tenant_tokens", len(fh.new_tokens),
-                    tenant=fh.tenant,
-                )
+                with obs.trace_ctx(fh.trace):
+                    obs.counter("fleet.completed", tenant=fh.tenant)
+                    obs.counter(
+                        "fleet.tenant_tokens", len(fh.new_tokens),
+                        tenant=fh.tenant,
+                    )
             else:
                 key = "cancelled" if reason == "cancelled" else "deadline"
                 self.stats[key] += 1
@@ -973,11 +1004,14 @@ class Router:
         for fh, reason in finished:
             key = "cancelled" if reason == "cancelled" else "deadline"
             self.stats[key] += 1
-            obs.counter(
-                "serve.cancelled" if reason == "cancelled"
-                else "serve.evicted_deadline",
-                tenant=t.name,
-            )
+            # Trace-stamped: the router-side terminal marker for a
+            # request reaped before (or between) replica attempts.
+            with obs.trace_ctx(fh.trace):
+                obs.counter(
+                    "serve.cancelled" if reason == "cancelled"
+                    else "serve.evicted_deadline",
+                    tenant=t.name,
+                )
             fh._finish(reason)
 
     def _dispatch(self, now: float) -> None:
@@ -1084,6 +1118,10 @@ class Router:
             fh.request,
             max_new_tokens=max_new,
             on_token=lambda _h, toks, fh=fh: fh._ingest(toks),
+            # The trace rides the Request across the router→replica
+            # thread boundary (thread-locals do not), so every attempt
+            # keeps the original request's causal identity.
+            trace=fh.trace,
             # fleet-level deadline already tracked on the FleetHandle;
             # the remaining budget rides to the replica so running
             # streams still get evicted there.
@@ -1097,8 +1135,27 @@ class Router:
         with self._lock:
             self._inflight.append(fh)
         self.stats["dispatched"] += 1
-        obs.counter("fleet.dispatched", tenant=fh.tenant,
-                    replica=replica.rid)
+        cause = fh._reroute_cause
+        with obs.trace_ctx(fh.trace, cause=cause):
+            if cause is not None:
+                # The re-route child span, linked to the parent trace:
+                # covers the requeue→re-dispatch window so the wall a
+                # chaos-plane intervention cost the request is an
+                # attributed phase, not an unexplained gap.
+                t_rq = fh._requeued_t
+                dur = 0.0 if t_rq is None else max(
+                    time.monotonic() - t_rq, 0.0
+                )
+                obs.span_event(
+                    "fleet.reroute", dur, t=t_rq, req=fh.id,
+                    replica=replica.rid, src=fh._reroute_from,
+                    attempt=fh.attempts,
+                )
+            obs.counter("fleet.dispatched", tenant=fh.tenant,
+                        replica=replica.rid)
+        fh._reroute_cause = None
+        fh._requeued_t = None
+        fh._reroute_from = None
 
     # -- brownout ladder actions (scheduler.BrownoutLadder drives) ---------
 
@@ -1155,7 +1212,8 @@ class Router:
                 t.deficit = 0.0
         for fh in victims:
             self.stats["brownout"] += 1
-            obs.counter("serve.brownout_shed", tenant=tenant)
+            with obs.trace_ctx(fh.trace, cause="brownout"):
+                obs.counter("serve.brownout_shed", tenant=tenant)
             fh._finish("brownout")
 
     # -- autoscale signal --------------------------------------------------

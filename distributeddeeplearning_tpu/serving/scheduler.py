@@ -10,8 +10,16 @@ through the obs bus:
 
 spans   ``serve.prefill`` (labels: bucket, slot, prompt_len),
         ``serve.decode_step`` (label: active),
+        ``serve.decode_share`` (per-slot share of a shared tick:
+        tick wall / occupied slots — the trace plane's decode
+        timeline), ``serve.delivery`` (stream fan-out + callback wall),
         ``serve.queue_wait`` / ``serve.ttft`` / ``serve.request``
         (measured durations — queue-wait, time-to-first-token, total)
+
+Every per-request emit runs under a bound trace context
+(``obs.trace_ctx`` — docs/OBSERVABILITY.md trace plane; the
+``obs-trace-ctx`` ddlint contract enforces this), so each event carries
+the request's ``trace`` id end to end across router → replica → tick.
 gauges  ``serve.slot_occupancy``, ``serve.queue_depth``,
         ``serve.programs``
 counters ``serve.admitted``, ``serve.completed``, ``serve.tokens``,
@@ -515,6 +523,11 @@ class Request:
     rng: Any = None
     deadline_ms: Optional[float] = None
     on_token: Any = None
+    # Trace identity (docs/OBSERVABILITY.md trace plane): set by the
+    # fleet router so a re-routed attempt keeps the original request's
+    # trace across the router→replica thread boundary; None mints a
+    # fresh trace at admission (direct Server use).
+    trace: Optional[str] = None
 
     def spec(self) -> ReqSpec:
         return ReqSpec(
@@ -549,6 +562,12 @@ class RequestHandle:
         self.queue_wait_s: Optional[float] = None
         self.ttft_s: Optional[float] = None
         self.finished_t: Optional[float] = None
+        # Trace plane: the request's causal identity (minted here at
+        # admission unless the fleet already owns one) and the wall
+        # spent inside _deliver (stream fan-out + client callbacks) —
+        # the critical path's delivery phase.
+        self.trace = req.trace or obs.new_trace_id()
+        self.deliver_s = 0.0
         self.done = threading.Event()
         self._cond = threading.Condition()
         self._cancel = False
@@ -603,6 +622,7 @@ class RequestHandle:
         iterators, fire the push callback. Never raises."""
         if not toks:
             return
+        t0 = time.monotonic()
         with self._cond:
             self.new_tokens.extend(int(t) for t in toks)
             self._cond.notify_all()
@@ -614,6 +634,7 @@ class RequestHandle:
                 obs.point(
                     "serve.stream_callback_error", req=self.id, error=repr(e)
                 )
+        self.deliver_s += time.monotonic() - t0
 
     def _notify_done(self) -> None:
         with self._cond:
@@ -664,6 +685,11 @@ class Server:
         self._ids = itertools.count()
         self._by_slot: Dict[int, RequestHandle] = {}
         self._closed = False
+        # The shared engine tick's own trace identity: decode steps are
+        # fleet-shared work, so the tick span lives on this per-server
+        # trace while each occupied slot gets a per-request
+        # serve.decode_share attribution (tick wall / occupied slots).
+        self._tick_trace = obs.new_trace_id()
         self.stats: Dict[str, Any] = {
             "admitted": 0, "completed": 0, "rejected": 0, "cancelled": 0,
             "deadline": 0, "tokens": 0, "decode_steps": 0,
@@ -710,13 +736,18 @@ class Server:
             # tightened the effective threshold while an SLO burns.
             if len(self._queue) >= self.queue_limit:
                 self.stats["rejected"] += 1
-                obs.counter("serve.rejected")
+                with obs.trace_ctx(request.trace):
+                    obs.counter("serve.rejected")
                 raise QueueFull(
                     f"admission queue at capacity ({self.queue_limit})"
                 )
             handle = RequestHandle(request, next(self._ids), now)
             self._queue.append(handle)
-            obs.gauge("serve.queue_depth", float(len(self._queue)))
+            with obs.trace_ctx(handle.trace):
+                obs.gauge("serve.queue_depth", float(len(self._queue)))
+        # Flight-recorder registry: this server's process now holds the
+        # trace until _finish / reclaim closes it.
+        obs.trace_open(handle.trace, req=handle.id)
         return handle
 
     # -- serving loop ------------------------------------------------------
@@ -726,20 +757,30 @@ class Server:
         handle.status = "done" if reason in ("eos", "length") else reason
         handle.finish_reason = reason
         handle.finished_t = now
-        if reason in ("eos", "length"):
-            self.stats["completed"] += 1
-            obs.counter("serve.completed")
-        obs.span_event(
-            "serve.request", now - handle.submitted_t, t=handle.submitted_t,
-            req=handle.id, reason=reason, tokens=len(handle.new_tokens),
-        )
-        obs.point(
-            "serve.request_done", req=handle.id, reason=reason,
-            tokens=len(handle.new_tokens),
-            ttft_ms=None if handle.ttft_s is None else round(
-                handle.ttft_s * 1e3, 3
-            ),
-        )
+        with obs.trace_ctx(handle.trace):
+            if reason in ("eos", "length"):
+                self.stats["completed"] += 1
+                obs.counter("serve.completed")
+            if handle.deliver_s:
+                # Stream fan-out + client-callback wall for this
+                # attempt — the critical path's delivery phase.
+                obs.span_event(
+                    "serve.delivery", handle.deliver_s, req=handle.id,
+                    tokens=len(handle.new_tokens),
+                )
+            obs.span_event(
+                "serve.request", now - handle.submitted_t,
+                t=handle.submitted_t, req=handle.id, reason=reason,
+                tokens=len(handle.new_tokens),
+            )
+            obs.point(
+                "serve.request_done", req=handle.id, reason=reason,
+                tokens=len(handle.new_tokens),
+                ttft_ms=None if handle.ttft_s is None else round(
+                    handle.ttft_s * 1e3, 3
+                ),
+            )
+        obs.trace_close(handle.trace)
         handle._notify_done()
 
     def _reap(self, now: float) -> None:
@@ -749,11 +790,13 @@ class Server:
             for h in self._queue:
                 if h._cancel:
                     self.stats["cancelled"] += 1
-                    obs.counter("serve.cancelled")
+                    with obs.trace_ctx(h.trace):
+                        obs.counter("serve.cancelled")
                     self._finish(h, "cancelled")
                 elif h.expired(now):
                     self.stats["deadline"] += 1
-                    obs.counter("serve.evicted_deadline")
+                    with obs.trace_ctx(h.trace):
+                        obs.counter("serve.evicted_deadline")
                     self._finish(h, "deadline")
                 else:
                     keep.append(h)
@@ -762,10 +805,11 @@ class Server:
             if h._cancel or h.expired(now):
                 reason = "cancelled" if h._cancel else "deadline"
                 self.stats["cancelled" if h._cancel else "deadline"] += 1
-                obs.counter(
-                    "serve.cancelled" if h._cancel
-                    else "serve.evicted_deadline"
-                )
+                with obs.trace_ctx(h.trace):
+                    obs.counter(
+                        "serve.cancelled" if h._cancel
+                        else "serve.evicted_deadline"
+                    )
                 self.engine.release(slot)
                 del self._by_slot[slot]
                 self._finish(h, reason)
@@ -793,26 +837,27 @@ class Server:
                 obs.gauge("serve.queue_depth", float(len(self._queue)))
             slot = free[0]
             handle.queue_wait_s = now - handle.submitted_t
-            obs.span_event(
-                "serve.queue_wait", handle.queue_wait_s,
-                t=handle.submitted_t, req=handle.id,
-            )
             spec = handle.request.spec()
-            with obs.span(
-                "serve.prefill", bucket=self.engine.bucket_for(
-                    spec.prompt.shape[0]
-                ), slot=slot, prompt_len=int(spec.prompt.shape[0]),
-            ):
-                first, eos_hit = self.engine.prefill(slot, spec)
-            handle.status = "running"
-            handle.ttft_s = time.monotonic() - handle.submitted_t
-            obs.span_event("serve.ttft", handle.ttft_s,
-                           t=handle.submitted_t, req=handle.id)
-            handle._deliver([first])
-            self.stats["admitted"] += 1
-            self.stats["tokens"] += 1
-            obs.counter("serve.admitted")
-            obs.counter("serve.tokens")  # the prefill-sampled first token
+            with obs.trace_ctx(handle.trace):
+                obs.span_event(
+                    "serve.queue_wait", handle.queue_wait_s,
+                    t=handle.submitted_t, req=handle.id,
+                )
+                with obs.span(
+                    "serve.prefill", bucket=self.engine.bucket_for(
+                        spec.prompt.shape[0]
+                    ), slot=slot, prompt_len=int(spec.prompt.shape[0]),
+                ):
+                    first, eos_hit = self.engine.prefill(slot, spec)
+                handle.status = "running"
+                handle.ttft_s = time.monotonic() - handle.submitted_t
+                obs.span_event("serve.ttft", handle.ttft_s,
+                               t=handle.submitted_t, req=handle.id)
+                handle._deliver([first])
+                self.stats["admitted"] += 1
+                self.stats["tokens"] += 1
+                obs.counter("serve.admitted")
+                obs.counter("serve.tokens")  # prefill-sampled first token
             admitted += 1
             if eos_hit or len(handle.new_tokens) >= spec.max_new_tokens:
                 self.engine.release(slot)
@@ -832,36 +877,52 @@ class Server:
             self.stats["peak_active"], len(self._by_slot)
         )
         if self._by_slot:
-            with obs.span("serve.decode_step", active=len(self._by_slot)):
-                # Speculative tier: one tick commits 1..spec_k+1 tokens
-                # per slot (draft + batched verify); the non-spec step
-                # is the single-token special case of the same shape.
-                # A brownout spec_off stage suspends speculation at
-                # runtime — the plain decode program is already in the
-                # closed set, so the fallback compiles nothing.
-                if self.engine.spec_enabled and not getattr(
-                    self.engine, "spec_suspended", False
-                ):
-                    emitted = self.engine.spec_step()
-                else:
-                    emitted = [
-                        (slot, [token], eos_hit)
-                        for slot, token, eos_hit in
-                        self.engine.decode_step()
-                    ]
+            active = len(self._by_slot)
+            tick_t0 = time.monotonic()
+            with obs.trace_ctx(self._tick_trace):
+                with obs.span("serve.decode_step", active=active):
+                    # Speculative tier: one tick commits 1..spec_k+1
+                    # tokens per slot (draft + batched verify); the
+                    # non-spec step is the single-token special case of
+                    # the same shape. A brownout spec_off stage suspends
+                    # speculation at runtime — the plain decode program
+                    # is already in the closed set, so the fallback
+                    # compiles nothing.
+                    if self.engine.spec_enabled and not getattr(
+                        self.engine, "spec_suspended", False
+                    ):
+                        emitted = self.engine.spec_step()
+                    else:
+                        emitted = [
+                            (slot, [token], eos_hit)
+                            for slot, token, eos_hit in
+                            self.engine.decode_step()
+                        ]
+            # Shared-tick attribution (docs/OBSERVABILITY.md): each
+            # occupied slot is charged an equal share of the tick wall,
+            # so a per-request decode timeline exists even though the
+            # engine batches all slots into one program dispatch.
+            share_s = (time.monotonic() - tick_t0) / active
             self.stats["decode_steps"] += 1
             n_tokens = 0
             for slot, toks, eos_hit in emitted:
                 h = self._by_slot.get(slot)
                 if h is None:
                     continue
-                h._deliver(toks)
-                self.stats["tokens"] += len(toks)
-                n_tokens += len(toks)
-                if eos_hit or len(h.new_tokens) >= h.request.max_new_tokens:
-                    self.engine.release(slot)
-                    del self._by_slot[slot]
-                    self._finish(h, "eos" if eos_hit else "length")
+                with obs.trace_ctx(h.trace):
+                    obs.span_event(
+                        "serve.decode_share", share_s, t=tick_t0,
+                        req=h.id, slot=slot, active=active,
+                    )
+                    h._deliver(toks)
+                    self.stats["tokens"] += len(toks)
+                    n_tokens += len(toks)
+                    if eos_hit or (
+                        len(h.new_tokens) >= h.request.max_new_tokens
+                    ):
+                        self.engine.release(slot)
+                        del self._by_slot[slot]
+                        self._finish(h, "eos" if eos_hit else "length")
             obs.counter("serve.tokens", n_tokens)
         with self._lock:
             busy = bool(self._by_slot or self._queue)
@@ -909,6 +970,9 @@ class Server:
             obs.gauge("serve.queue_depth", 0.0)
         for h in out:
             h.status = "requeued"
+            # The trace leaves with the request — this process no
+            # longer holds it (flight-recorder registry).
+            obs.trace_close(h.trace)
         return out
 
     def take_running(self) -> List[RequestHandle]:
@@ -927,6 +991,7 @@ class Server:
                 pass  # a faulted engine's bookkeeping may be wrecked
             del self._by_slot[slot]
             h.status = "requeued"
+            obs.trace_close(h.trace)
             out.append(h)
         return out
 

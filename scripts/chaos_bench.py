@@ -227,8 +227,80 @@ def run_storm(model, params, reqs, scfg, fcfg, max_len, tenants, *,
     outcomes = [fh.finish_reason for _, fh in handles]
     splice_ok = all(fh.restart_consistent for _, fh in handles)
     mismatches = sum(fh.splice_mismatches for _, fh in handles)
+    # Trace plane: each backlog request's trace id + dispatch count, so
+    # the trace-verification gate can key the reconstructed critical
+    # paths back to what the storm actually did to each request.
+    trace_info = [
+        {"trace": fh.trace, "attempts": fh.attempts,
+         "outcome": fh.finish_reason}
+        for _, fh in handles
+    ]
     router.close()
-    return run, streams, outcomes, splice_ok, mismatches
+    return run, streams, outcomes, splice_ok, mismatches, trace_info
+
+
+def trace_gates(storm_traces, obs_dir):
+    """Trace-verification gate (docs/OBSERVABILITY.md trace plane):
+    reconstruct critical paths from the storm's event files and check
+
+    * every backlog request's trace reconstructs (admission + terminal
+      — no orphan);
+    * every re-routed (hedged/spliced/migrated) request's trace carries
+      the ``fleet.reroute`` child span with a correct ``cause``;
+    * every non-shed request's phase sum matches its measured
+      end-to-end latency within the documented gap tolerance.
+
+    Returns the gate dict; the caller folds ``*_ok`` values into the
+    bench verdict."""
+    from distributeddeeplearning_tpu import obs
+    from distributeddeeplearning_tpu.obs import report, traces
+
+    obs.flush()  # the router-side (process-global) stream
+    loaded = report.load([obs_dir])
+    recon = traces.reconstruct(loaded)
+    # The run dir may also hold warm-pass (and stale) traces — gate on
+    # the storm backlog's trace ids only.
+    ids = {t["trace"] for t in storm_traces}
+    by_trace = {
+        r["trace"]: r for r in recon["requests"] + recon["orphans"]
+        if r["trace"] in ids
+    }
+    orphans = [
+        r["trace"] for r in recon["orphans"] if r["trace"] in ids
+    ]
+    missing = sorted(ids - set(by_trace))
+    rerouted = [
+        t for t in storm_traces
+        if t["attempts"] >= 2 and t["outcome"] != "brownout"
+    ]
+    bad_reroutes = []
+    for t in rerouted:
+        r = by_trace.get(t["trace"])
+        spans = [
+            iv for iv in (r["interventions"] if r else [])
+            if iv["what"] == "fleet.reroute"
+        ]
+        if not spans or any(
+            iv.get("cause") not in ("hedge", "splice", "migration")
+            for iv in spans
+        ):
+            bad_reroutes.append(t["trace"])
+    over_tolerance = [
+        r["trace"] for tid, r in sorted(by_trace.items())
+        if r["outcome"] not in ("brownout", "orphan")
+        and not r["within_tolerance"]
+    ]
+    return {
+        "traces_reconstructed": len(by_trace),
+        "traces_expected": len(ids),
+        "all_reconstructed_ok": not missing and not orphans,
+        "trace_orphans": len(orphans),
+        "rerouted_requests": len(rerouted),
+        "reroute_cause_ok": not bad_reroutes,
+        "bad_reroute_traces": bad_reroutes,
+        "phase_sum_ok": not over_tolerance,
+        "over_tolerance_traces": over_tolerance,
+    }
 
 
 def main() -> int:
@@ -320,16 +392,15 @@ def main() -> int:
             tenants, n_requests, 0.0, seed, vocab, shapes
         )
 
-        base, base_streams, base_outcomes, _, _ = run_storm(
+        base, base_streams, base_outcomes, _, _, _ = run_storm(
             model, params, reqs, scfg, fcfg, max_len, tenants,
             chaos_plan="", brownout_stages="", burn_window=burn_window,
         )
-        storm, storm_streams, storm_outcomes, splice_ok, mismatches = (
-            run_storm(
-                model, params, reqs, scfg, fcfg, max_len, tenants,
-                chaos_plan=chaos_plan, brownout_stages=brownout_stages,
-                burn_window=burn_window,
-            )
+        (storm, storm_streams, storm_outcomes, splice_ok, mismatches,
+         storm_traces) = run_storm(
+            model, params, reqs, scfg, fcfg, max_len, tenants,
+            chaos_plan=chaos_plan, brownout_stages=brownout_stages,
+            burn_window=burn_window,
         )
 
         shed_idx = [
@@ -386,11 +457,22 @@ def main() -> int:
         brownout_up = any(
             t["direction"] == "up" for t in storm["brownout_transitions"]
         )
+        # Trace-verification gate — only when the event streams were
+        # captured (OBS_DIR); without files there is nothing to audit.
+        tgates = None
+        if os.environ.get("OBS_DIR"):
+            tgates = trace_gates(storm_traces, os.environ["OBS_DIR"])
+        trace_ok = tgates is None or (
+            tgates["all_reconstructed_ok"]
+            and tgates["reroute_cause_ok"]
+            and tgates["phase_sum_ok"]
+        )
         ok = (
             parity and completed_ok and shed_marked and closed and clean
             and (corrupt_detected and corrupt_healed if corrupt_armed
                  else True)
             and breaker_ok and ttft_ok and brownout_down and brownout_up
+            and trace_ok
         )
         detail = {
             "profile": profile,
@@ -423,8 +505,11 @@ def main() -> int:
                 "ttft_bounded": ttft_ok,
                 "brownout_step_down": brownout_down,
                 "brownout_step_up": brownout_up,
+                "trace_plane_ok": trace_ok,
             },
         }
+        if tgates is not None:
+            detail["trace_gates"] = tgates
         record = {
             "metric": metric,
             "value": storm["tokens_per_sec"],

@@ -174,6 +174,18 @@ def render(snapshot: dict) -> str:
         add("fleet health: " + "  ".join(
             f"{label} {v:.0f}" for label, v in health
         ))
+    # Trace-plane row (obs/traces.py): distinct request traces active
+    # in the window + chaos re-routes by cause. Absent on untraced runs.
+    tr = snapshot.get("traces")
+    if tr:
+        parts = [f"{tr.get('distinct', 0)} active trace(s)"]
+        reroutes = tr.get("reroutes") or {}
+        if reroutes:
+            parts.append("reroutes " + ", ".join(
+                f"{cause} x{n}" for cause, n in sorted(reroutes.items())
+            ))
+        add("")
+        add("traces (window): " + "  ".join(parts))
     if replicas:
         add("")
         add("serving replicas (one row per events-*-s<k> stream):")

@@ -1,0 +1,152 @@
+"""Per-request critical-path digest from trace-stamped event files.
+
+The trace plane's CLI (``distributeddeeplearning_tpu/obs/traces.py``):
+point it at a run directory (``OBS_DIR``) or any set of
+``events*.jsonl`` files and it reconstructs every request's critical
+path — queue wait → prefill → decode ticks → delivery, with chaos
+re-routes attributed by cause — then renders the top-K-slowest digest:
+each slow request decomposed per phase against the fleet p50, naming
+the dominant culprit. Training runs get the same treatment per step
+(data wait vs dispatch vs collective).
+
+Usage::
+
+    python scripts/trace_report.py RUN_DIR_OR_FILES... [--json] [--top K]
+    make trace-report                 # newest runs/<dir>
+
+Gap accounting is first-class: each request's phases must sum to its
+measured end-to-end latency within ``max(GAP_TOL_S, GAP_TOL_FRAC *
+e2e)`` (docs/OBSERVABILITY.md); the unattributed remainder is printed,
+never hidden. Orphan traces (admission point without a terminal
+outcome) are listed — a healthy run has zero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _ms(v) -> str:
+    return f"{(v or 0.0) * 1e3:.1f}ms"
+
+
+def render(recon: dict, training, top_k: int) -> str:
+    from distributeddeeplearning_tpu.obs import traces
+
+    out: List[str] = []
+    add = out.append
+    add(
+        f"trace digest: {recon['count']} request(s), "
+        f"{recon['within_tolerance']} within gap tolerance "
+        f"(max({traces.GAP_TOL_S:g}s, {traces.GAP_TOL_FRAC:.0%} of e2e)), "
+        f"{recon['sheds']} shed, {recon['orphan_count']} orphan(s)"
+    )
+    if recon["causes"]:
+        add("interventions: " + ", ".join(
+            f"{c} x{n}" for c, n in sorted(recon["causes"].items())
+        ))
+    reqs = recon["requests"]
+    if reqs:
+        p50s = traces.phase_p50s(reqs)
+        add("")
+        add("fleet p50 per phase: " + "  ".join(
+            f"{p} {_ms(p50s[p])}" for p in traces.PHASES
+        ) + f"  gap {_ms(p50s['gap'])}  e2e {_ms(p50s['e2e'])}")
+        add("")
+        add(f"top {top_k} slowest (phase / +excess vs fleet p50):")
+        for r in traces.top_slow(reqs, k=top_k, p50s=p50s):
+            add(
+                f"  req={r.get('req', '?')} tenant={r.get('tenant', '?')} "
+                f"e2e {_ms(r['e2e_s'])} outcome={r['outcome']} "
+                f"attempts={r['attempts']}"
+                f"  <- culprit: {r['culprit']} "
+                f"(+{_ms(r['culprit_excess_s'])})"
+            )
+            cells = []
+            for p in traces.PHASES:
+                v = r["phases"].get(p, 0.0)
+                if v or r["excess"].get(p):
+                    cells.append(f"{p} {_ms(v)} (+{_ms(r['excess'][p])})")
+            cells.append(
+                f"gap {_ms(max(r['gap_s'], 0.0))}"
+                + ("" if r["within_tolerance"] else " OVER TOLERANCE")
+            )
+            add("      " + "  ".join(cells))
+            for iv in r["interventions"]:
+                add(
+                    f"      intervention: {iv['what']} "
+                    f"cause={iv.get('cause', '?')}"
+                    + (f" from-replica={iv['src']}"
+                       if iv.get("src") is not None else "")
+                    + (f" replica={iv['replica']}"
+                       if iv.get("replica") is not None else "")
+                    + (f" dur {_ms(iv['dur_s'])}"
+                       if iv.get("dur_s") else "")
+                )
+    for o in recon["orphans"]:
+        add(
+            f"ORPHAN trace {o['trace']}: admission seen, no terminal "
+            f"outcome ({o['events']} event(s), last wall {o['end_wall']})"
+        )
+    if training:
+        add("")
+        add(
+            f"training attribution ({training['steps']} step(s), "
+            f"{training['procs']} proc(s)): "
+            f"wall {training['wall_s']:.3f}s = "
+            f"dispatch {training['dispatch_s']:.3f}s + "
+            f"data wait {training['data_wait_s']:.3f}s + "
+            f"collective {training['collective_s']:.3f}s + "
+            f"other {training['other_s']:.3f}s"
+        )
+        for s in training["slowest"]:
+            add(
+                f"  slow step p={s['p']} epoch={s.get('epoch', '?')}: "
+                f"wall {s['wall_s']:.3f}s (dispatch {s['dispatch_s']:.3f}s, "
+                f"data wait {s['data_wait_s']:.3f}s, "
+                f"other {s['other_s']:.3f}s)"
+            )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("paths", nargs="+", help="run dir(s) and/or events*.jsonl")
+    p.add_argument("--json", action="store_true", help="emit digest JSON")
+    p.add_argument("--top", type=int, default=5, help="slowest requests shown")
+    args = p.parse_args(argv)
+
+    from distributeddeeplearning_tpu.obs import report, traces
+
+    try:
+        loaded = report.load(args.paths)
+    except FileNotFoundError as e:
+        print(f"ERROR: no event files under {e}", file=sys.stderr)
+        return 2
+    recon = traces.reconstruct(loaded)
+    training = traces.training_attribution(loaded)
+    if args.json:
+        out = dict(recon)
+        out["top_slow"] = traces.top_slow(recon["requests"], k=args.top)
+        out["training"] = training
+        print(json.dumps(out, default=str))
+    elif not recon["count"] and not recon["orphan_count"] and not training:
+        print(
+            "no trace-stamped request events found (run predates the "
+            "trace plane, or nothing was served)"
+        )
+    else:
+        print(render(recon, training, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

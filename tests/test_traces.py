@@ -1,0 +1,404 @@
+"""Trace-plane oracles: context propagation, critical-path
+reconstruction, the obs-trace-ctx lint, and malformed-input hardening.
+
+CPU-tier provable invariants (docs/OBSERVABILITY.md, trace plane):
+
+* ``obs.trace_ctx`` stamps every emit (any bus) with the thread-local
+  trace coordinates; nesting links ``parent`` within the same trace;
+  causal child spans carry their ``cause``; other threads stay
+  unstamped; ``obs.reset()`` drops the binding.
+* The flight recorder's dump header names the traces the process held
+  (``trace_open``/``trace_close``) so a crash post-mortem can join them.
+* ``obs/traces.py`` rebuilds per-request critical paths from a
+  synthetic timeline: phases sum to e2e within the documented
+  tolerance, interventions keep their cause, sheds/orphans/tick-traces
+  are classified, the top-slow digest fingers the dominant culprit,
+  and the training reconstructor decomposes step windows.
+* ddlint's ``obs-trace-ctx`` flags traced-family emits outside a bound
+  context (function boundaries are barriers) and self-hosts clean.
+* The report/tail readers degrade gracefully on what dying processes
+  leave behind: truncated JSONL mid-record, empty event files, a trace
+  whose parent span never closed (an orphan, not a crash).
+"""
+
+import ast
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.analysis import (
+    apply_suppressions,
+    package_sources,
+)
+from distributeddeeplearning_tpu.analysis import contracts
+from distributeddeeplearning_tpu.obs import report as obs_report
+from distributeddeeplearning_tpu.obs import traces
+from distributeddeeplearning_tpu.obs.bus import EventBus, TraceContext
+from distributeddeeplearning_tpu.obs.tail import Tailer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_bus():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Context propagation (obs/bus.py)
+# ---------------------------------------------------------------------------
+
+def test_trace_ctx_stamps_nests_and_restores():
+    bus = EventBus(ring_size=16)
+    bus.point("before")
+    with obs.trace_ctx("aaaabbbbcccc") as ctx:
+        bus.counter("serve.request", reason="eos")
+        with obs.trace_ctx("aaaabbbbcccc", cause="hedge") as child:
+            bus.span_event("fleet.reroute", 0.01)
+        with obs.trace_ctx(None):  # passthrough keeps the binding
+            assert obs.current_trace() is ctx
+            bus.point("still.traced")
+    bus.point("after")
+    assert obs.current_trace() is None
+
+    by_name = {r["name"]: r for r in bus.ring}
+    assert "trace" not in by_name["before"]
+    assert "trace" not in by_name["after"]
+    req = by_name["serve.request"]
+    assert req["trace"] == "aaaabbbbcccc" and req["span"] == ctx.span
+    assert "parent" not in req and "cause" not in req
+    rr = by_name["fleet.reroute"]
+    # Nested under the same trace: the child links back to the
+    # enclosing span and carries its cause.
+    assert child.parent == ctx.span
+    assert rr["parent"] == ctx.span and rr["cause"] == "hedge"
+    assert rr["span"] != ctx.span
+    assert by_name["still.traced"]["span"] == ctx.span
+
+
+def test_trace_ctx_rebinds_ready_made_context():
+    # How a component re-binds a context that crossed a thread boundary
+    # on the Request object: bound as-is, span preserved.
+    ctx = TraceContext("ddddeeeeffff", span="01234567")
+    with obs.trace_ctx(ctx) as bound:
+        assert bound is ctx
+        assert obs.current_trace().span == "01234567"
+    assert obs.current_trace() is None
+
+
+def test_trace_ctx_is_thread_local():
+    seen = {}
+    with obs.trace_ctx(obs.new_trace_id()):
+        t = threading.Thread(
+            target=lambda: seen.update(ctx=obs.current_trace())
+        )
+        t.start()
+        t.join()
+    assert seen["ctx"] is None  # the binding never leaks across threads
+
+
+def test_reset_drops_binding():
+    with obs.trace_ctx("aaaabbbbcccc"):
+        obs.reset()
+        assert obs.current_trace() is None
+
+
+def test_flight_dump_names_active_traces(tmp_path):
+    bus = EventBus(directory=str(tmp_path), proc=0, run_id="r-t")
+    bus.trace_open("aaaabbbbcccc", req=7, tenant="gold")
+    bus.point("x")
+    path = bus.dump_flight("test")
+    header = json.loads(open(path).readline())
+    assert header["kind"] == "flight_meta"
+    active = header["active_traces"]
+    assert active["aaaabbbbcccc"]["req"] == 7
+    assert "opened_t" in active["aaaabbbbcccc"]
+    bus.trace_close("aaaabbbbcccc")
+    assert bus.active_traces() == {}
+    # A dump with nothing in flight omits the header key entirely.
+    header2 = json.loads(open(bus.dump_flight("test2")).readline())
+    assert "active_traces" not in header2
+
+
+# ---------------------------------------------------------------------------
+# Critical-path reconstruction (obs/traces.py)
+# ---------------------------------------------------------------------------
+
+def _span(name, wall, dur, trace, **extra):
+    return {"kind": "span", "name": name, "wall": wall, "dur": dur,
+            "trace": trace, **extra}
+
+
+def _synthetic_fleet():
+    """A hand-built timeline: one clean request, one hedged decode-bound
+    straggler, one brownout shed, one orphan, one engine-tick trace."""
+    ev = [
+        # t1: clean, phases sum exactly to e2e.
+        {"kind": "point", "name": "fleet.submitted", "wall": 100.0,
+         "trace": "t1", "labels": {"req": 1, "tenant": "gold"}},
+        {"kind": "gauge", "name": "serve.queue_depth", "wall": 100.02,
+         "trace": "t1", "value": 1},
+        _span("serve.queue_wait", 100.02, 0.05, "t1"),
+        _span("serve.prefill", 100.07, 0.03, "t1"),
+        _span("serve.ttft", 100.0, 0.12, "t1"),
+        _span("serve.decode_share", 100.10, 0.10, "t1"),
+        _span("serve.delivery", 100.20, 0.01, "t1"),
+        {"kind": "counter", "name": "serve.request", "wall": 100.21,
+         "trace": "t1", "labels": {"reason": "eos", "tokens": 7}},
+        # t2: hedged off replica 1 mid-decode; decode dominates.
+        {"kind": "point", "name": "fleet.submitted", "wall": 100.0,
+         "trace": "t2", "labels": {"req": 2, "tenant": "bronze"}},
+        {"kind": "gauge", "name": "serve.queue_depth", "wall": 100.03,
+         "trace": "t2", "value": 1},
+        _span("serve.queue_wait", 100.03, 0.05, "t2"),
+        _span("serve.prefill", 100.08, 0.03, "t2"),
+        _span("serve.decode_share", 100.11, 0.40, "t2"),
+        _span("fleet.reroute", 100.55, 0.20, "t2", cause="hedge",
+              labels={"req": 2, "replica": 0, "src": 1, "attempt": 2}),
+        _span("serve.queue_wait", 100.75, 0.05, "t2"),
+        _span("serve.prefill", 100.80, 0.03, "t2"),
+        _span("serve.decode_share", 100.83, 0.80, "t2"),
+        _span("serve.delivery", 101.65, 0.01, "t2"),
+        {"kind": "counter", "name": "serve.request", "wall": 101.66,
+         "trace": "t2", "labels": {"reason": "length", "tokens": 16}},
+        # t3: brownout shed at admission.
+        {"kind": "point", "name": "fleet.submitted", "wall": 100.0,
+         "trace": "t3", "labels": {"req": 3, "tenant": "bronze"}},
+        {"kind": "counter", "name": "serve.brownout_shed", "wall": 100.01,
+         "trace": "t3", "labels": {"tenant": "bronze"}},
+        # t4: admission point, no terminal — an orphan.
+        {"kind": "point", "name": "fleet.submitted", "wall": 100.0,
+         "trace": "t4", "labels": {"req": 4, "tenant": "gold"}},
+        {"kind": "gauge", "name": "serve.queue_depth", "wall": 100.05,
+         "trace": "t4", "value": 2},
+        # t5: the scheduler's shared engine-tick trace — not a request.
+        _span("serve.decode_step", 100.0, 0.01, "t5"),
+        _span("serve.decode_step", 100.02, 0.01, "t5"),
+        # Unstamped background noise must not leak into any trace.
+        {"kind": "gauge", "name": "proc.rss_mb", "wall": 100.0,
+         "value": 10.0},
+    ]
+    return ev
+
+
+def test_reconstruct_classifies_and_accounts():
+    recon = traces.reconstruct(_synthetic_fleet())
+    assert recon["count"] == 3
+    assert recon["orphan_count"] == 1
+    assert recon["sheds"] == 1
+    assert recon["within_tolerance"] == 3
+    assert recon["causes"] == {"hedge": 1, "brownout": 1}
+    by_trace = {r["trace"]: r for r in recon["requests"]}
+    assert set(by_trace) == {"t1", "t2", "t3"}  # t5 is no request at all
+
+    t1 = by_trace["t1"]
+    assert t1["outcome"] == "done" and t1["reason"] == "eos"
+    assert t1["tokens"] == 7 and t1["attempts"] == 1
+    assert t1["tenant"] == "gold" and t1["req"] == 1
+    assert t1["e2e_s"] == pytest.approx(0.21)
+    assert t1["phases"]["router_wait"] == pytest.approx(0.02)
+    assert t1["phases"]["decode"] == pytest.approx(0.10)
+    assert t1["gap_s"] == pytest.approx(0.0, abs=1e-6)
+    assert t1["within_tolerance"]
+
+    t2 = by_trace["t2"]
+    assert t2["outcome"] == "done" and t2["attempts"] == 2
+    assert t2["phases"]["decode"] == pytest.approx(1.20)
+    assert t2["phases"]["reroute"] == pytest.approx(0.20)
+    assert t2["phases"]["queue_wait"] == pytest.approx(0.10)
+    assert t2["gap_s"] <= traces.gap_tolerance_s(t2["e2e_s"])
+    [rr] = [i for i in t2["interventions"] if i["what"] == "fleet.reroute"]
+    assert rr["cause"] == "hedge"
+    assert rr["replica"] == 0 and rr["src"] == 1  # dest vs culprit
+
+    t3 = by_trace["t3"]
+    assert t3["outcome"] == "brownout"
+    assert t3["causes"] == ["brownout"]
+
+    [orphan] = recon["orphans"]
+    assert orphan["trace"] == "t4" and orphan["outcome"] == "orphan"
+
+
+def test_top_slow_fingers_dominant_culprit():
+    recon = traces.reconstruct(_synthetic_fleet())
+    p50s = traces.phase_p50s(recon["requests"])
+    # Sheds never ran phases: they are excluded from the baseline.
+    assert p50s["decode"] == pytest.approx(0.10)
+    rows = traces.top_slow(recon["requests"], k=2, p50s=p50s)
+    assert [r["trace"] for r in rows] == ["t2", "t1"]
+    assert rows[0]["culprit"] == "decode"
+    assert rows[0]["culprit_excess_s"] == pytest.approx(1.10)
+
+
+def test_gap_over_tolerance_is_flagged_not_absorbed():
+    ev = [
+        {"kind": "point", "name": "fleet.submitted", "wall": 0.0,
+         "trace": "tg", "labels": {"req": 9}},
+        _span("serve.queue_wait", 0.0, 0.01, "tg"),
+        # 3s of nothing, then the terminal: almost all wall unattributed.
+        {"kind": "counter", "name": "serve.request", "wall": 3.0,
+         "trace": "tg", "labels": {"reason": "eos", "tokens": 1}},
+    ]
+    [r] = traces.reconstruct(ev)["requests"]
+    assert r["gap_s"] == pytest.approx(2.99)
+    assert r["gap_tolerance_s"] == pytest.approx(max(
+        traces.GAP_TOL_S, traces.GAP_TOL_FRAC * 3.0
+    ))
+    assert not r["within_tolerance"]
+
+
+def test_training_attribution_decomposes_step_windows():
+    ev = [
+        {"kind": "span", "name": "step", "wall": 10.0, "dur": 0.5, "p": 0,
+         "labels": {"epoch": 0}},
+        {"kind": "span", "name": "data.wait", "wall": 10.5, "dur": 0.3,
+         "p": 0},
+        {"kind": "span", "name": "step", "wall": 10.9, "dur": 0.4, "p": 0,
+         "labels": {"epoch": 0}},
+    ]
+    t = traces.training_attribution(ev)
+    assert t["steps"] == 2 and t["procs"] == 1
+    assert t["dispatch_s"] == pytest.approx(0.9)
+    assert t["data_wait_s"] == pytest.approx(0.3)
+    assert t["other_s"] == pytest.approx(0.1)
+    assert t["wall_s"] == pytest.approx(1.3)
+    assert t["slowest"][0]["wall_s"] == pytest.approx(0.8)
+    # Serving-only runs have no step spans: no section, not zeros.
+    assert traces.training_attribution(_synthetic_fleet()) is None
+
+
+# ---------------------------------------------------------------------------
+# ddlint: obs-trace-ctx
+# ---------------------------------------------------------------------------
+
+_LINT_FIXTURE = textwrap.dedent(
+    """
+    def naked(bus):
+        bus.counter("serve.request", reason="eos")
+
+    def wrapped(bus, h):
+        with obs.trace_ctx(h.trace):
+            bus.span_event("serve.prefill", 0.1)
+            with bus.span("serve.decode_share"):
+                pass
+
+    def barrier(bus, h):
+        with obs.trace_ctx(h.trace):
+            def later():
+                bus.span_event("serve.delivery", 0.1)
+            return later
+
+    def untraced_family(bus):
+        bus.gauge("serve.queue_depth", 3)
+    """
+)
+
+
+def test_obs_trace_ctx_flags_naked_and_respects_barriers():
+    v = contracts._NakedTracedEmits()
+    v.visit(ast.parse(_LINT_FIXTURE))
+    flagged = [name for name, _, _ in v.naked]
+    # The naked emit and the deferred closure (an outer `with` cannot
+    # cover code that runs later) are caught; the wrapped emits and the
+    # non-traced family are not.
+    assert flagged == ["serve.request", "serve.delivery"]
+
+
+def test_obs_trace_ctx_self_hosts_clean():
+    out = apply_suppressions(
+        contracts.run_obs_trace_ctx(), package_sources()
+    )
+    assert [f.format() for f in out if not f.suppressed] == []
+
+
+def test_trace_hot_paths_exist():
+    from distributeddeeplearning_tpu.analysis.contracts import (
+        REPO_ROOT,
+        TRACE_HOT_PATHS,
+    )
+    for rel in TRACE_HOT_PATHS:
+        assert os.path.exists(os.path.join(REPO_ROOT, rel)), rel
+
+
+# ---------------------------------------------------------------------------
+# Malformed input: report + tail degrade, never raise
+# ---------------------------------------------------------------------------
+
+_META = {"kind": "meta", "run": "r-mal", "p": 0, "pid": 1,
+         "mono0": 0.0, "wall0": 1000.0}
+
+
+def _write_events(path, lines):
+    with open(path, "w") as fh:
+        fh.write("".join(lines))
+
+
+def test_report_and_tail_survive_truncated_mid_record(tmp_path):
+    p = str(tmp_path / "events-p0.jsonl")
+    good = {"t": 1.0, "kind": "counter", "name": "serve.request", "p": 0,
+            "value": 1, "trace": "aaaabbbbcccc",
+            "labels": {"reason": "eos"}}
+    _write_events(p, [
+        json.dumps(_META) + "\n",
+        json.dumps(good) + "\n",
+        '{"t": 2.0, "kind": "coun',  # the process died mid-write
+    ])
+    loaded = obs_report.load([str(tmp_path)])
+    assert len(loaded["events"]) == 1
+    text = obs_report.render(obs_report.summarize(loaded))
+    assert "serve.request" in text
+
+    tailer = Tailer(str(tmp_path))
+    first = tailer.poll()
+    assert [e["name"] for e in first] == ["serve.request"]
+    assert first[0]["wall"] == pytest.approx(1001.0)
+    # The torn tail is held back, not mis-parsed: completing the line
+    # later delivers the record on the next poll.
+    with open(p, "a") as fh:
+        fh.write('ter", "name": "late", "p": 0}\n')
+    assert [e["name"] for e in tailer.poll()] == ["late"]
+    assert tailer.errors == 0
+
+
+def test_report_and_tail_survive_empty_event_file(tmp_path):
+    p = str(tmp_path / "events-p0.jsonl")
+    _write_events(p, [])
+    loaded = obs_report.load([str(tmp_path)])
+    assert loaded["events"] == []
+    summary = obs_report.summarize(loaded)
+    assert summary["traces"] is None  # nothing stamped, section omitted
+    assert isinstance(obs_report.render(summary), str)
+    assert Tailer(str(tmp_path)).poll() == []
+
+
+def test_report_surfaces_never_closed_parent_span_as_orphan(tmp_path):
+    # A request whose enclosing span never closed (the replica died
+    # holding it): admission markers exist, no terminal, no span end.
+    evs = [
+        {"t": 1.0, "kind": "point", "name": "fleet.submitted", "p": 0,
+         "trace": "deadbeefcafe", "span": "01234567",
+         "labels": {"req": 5, "tenant": "gold"}},
+        {"t": 1.1, "kind": "gauge", "name": "serve.queue_depth", "p": 0,
+         "value": 1, "trace": "deadbeefcafe", "span": "01234567"},
+        {"t": 1.2, "kind": "span", "name": "serve.prefill", "p": 0,
+         "dur": 0.05, "trace": "deadbeefcafe", "parent": "01234567",
+         "span": "89abcdef"},
+    ]
+    _write_events(
+        str(tmp_path / "events-p0.jsonl"),
+        [json.dumps(_META) + "\n"]
+        + [json.dumps(e) + "\n" for e in evs],
+    )
+    loaded = obs_report.load([str(tmp_path)])
+    summary = obs_report.summarize(loaded)
+    tr = summary["traces"]
+    assert tr is not None and tr["requests"] == 0
+    assert tr["orphans"] == 1
+    assert isinstance(obs_report.render(summary), str)
+    recon = traces.reconstruct(loaded)
+    [o] = recon["orphans"]
+    assert o["trace"] == "deadbeefcafe" and o["events"] == 3
