@@ -26,7 +26,8 @@ PY                ?= python
         notebooks bench recertify decode-audit heavy-refresh obs-report \
         obs-watch trace-report bench-trend accum-memory fault-suite \
         elastic-drill \
-        serve-bench serve-bench-spec fleet-bench chaos-bench stream-shards \
+        serve-bench serve-bench-spec fleet-bench chaos-bench coloc-bench \
+        stream-shards \
         stream-bench native \
         provision setup submit stream status stop teardown
 
@@ -129,6 +130,16 @@ chaos-bench:	## seeded mixed-verb fault storm over a closed 3-tenant
 	## multiple (docs/ROBUSTNESS.md serving failure model;
 	## serve_lm_chaos recertify row; SERVE_CHAOS_PLAN/SERVE_CHAOS_SEED)
 	$(PY) scripts/chaos_bench.py
+
+coloc-bench:	## combined fault+chaos storm over ONE device pool: a
+	## serving surge drives the brownout ladder to exhaustion, the
+	## arbiter shrinks training via the capacity file, the controller's
+	## scale-up is lease-gated, then reclaim drains the leased replica
+	## zero-drop and training grows back — training trajectory must
+	## re-join the uninterrupted run at f32 ULP, p99 TTFT holds the
+	## COLOC_TTFT_SLO_MS bound, zero dropped or mixed-version requests
+	## (docs/ROBUSTNESS.md colocation; lm_coloc recertify row)
+	$(PY) scripts/coloc_bench.py
 
 accum-memory:	## host-side proof: compiled activation bytes vs ACCUM_STEPS (PROFILE.md)
 	$(PY) scripts/accum_memory.py

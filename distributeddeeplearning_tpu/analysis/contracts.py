@@ -423,7 +423,7 @@ def run_obs_trace_ctx() -> List[Finding]:
 # protocol-vars
 # ---------------------------------------------------------------------------
 
-_PROTOCOL_PREFIXES = ("SERVE_", "STREAM_", "BENCH_")
+_PROTOCOL_PREFIXES = ("SERVE_", "STREAM_", "BENCH_", "ARBITER_", "COLOC_")
 
 
 def _recertify_tables() -> Tuple[Set[str], Dict[str, Set[str]], str]:

@@ -378,7 +378,9 @@ class FaultInjector:
             bus.flush()
             if f.kind == "restore_capacity":
                 if self.capacity_file:
-                    write_capacity(self.capacity_file, self.full_world)
+                    write_capacity(
+                        self.capacity_file, self.full_world, owner="fault"
+                    )
                 continue
             # Capacity is a CLUSTER-level notion: the drill means "the
             # full world lost f.ranks processes", so the probe reads
@@ -394,6 +396,7 @@ class FaultInjector:
                     self.capacity_file,
                     max(self.full_world - f.ranks, 0),
                     restore_at=restore_at,
+                    owner="fault",
                 )
             if self.rank >= max(self.world - f.ranks, 0):
                 # This process is one of the preempted casualties:
@@ -434,43 +437,99 @@ class FaultInjector:
 #: the fault injector's shrink/restore_capacity verbs.
 CAPACITY_FILE_ENV = "ELASTIC_CAPACITY_FILE"
 
+#: Env var: TTL in seconds beyond which a capacity file's mtime marks it
+#: stale (a dead writer's leftover lease). 0 — the default — disables
+#: the TTL. A stale file reads as "no change", never as a shrink.
+CAPACITY_STALE_ENV = "CAPACITY_STALE_S"
+
+#: Owners the capacity grammar recognises. ``None`` (legacy files
+#: written before the owner field existed) stays valid; any other
+#: unknown owner marks the file invalid — a foreign writer must never
+#: silently shrink the world.
+CAPACITY_OWNERS = ("fault", "arbiter", "operator")
+
 
 def write_capacity(
-    path: str, available: int, restore_at: Optional[float] = None
+    path: str,
+    available: int,
+    restore_at: Optional[float] = None,
+    owner: Optional[str] = None,
 ) -> None:
     """Atomically record cluster capacity: ``available`` schedulable
-    processes, optionally restored to full at wall-clock ``restore_at``.
-    In production the probe would ask the resource manager; the drills
-    make the same contract a file so the whole shrink→grow cycle is
-    reproducible."""
+    processes, optionally restored to full at wall-clock ``restore_at``
+    (doubles as the lease expiry when ``owner`` holds the reduction —
+    docs/ROBUSTNESS.md colocation section). In production the probe
+    would ask the resource manager; the drills make the same contract a
+    file so the whole shrink→grow cycle is reproducible."""
     import json
 
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump(
-            {"available": int(available), "restore_at": restore_at}, fh
+            {
+                "available": int(available),
+                "restore_at": restore_at,
+                "owner": owner,
+            },
+            fh,
         )
     os.replace(tmp, path)
 
 
-def probe_capacity(path: Optional[str], full: int) -> int:
+def probe_capacity(
+    path: Optional[str], full: int, *, current: Optional[int] = None
+) -> int:
     """How many processes can be scheduled right now. No capacity file
-    (or an unreadable one — never block a relaunch on a torn write)
     means full capacity; a recorded ``restore_at`` in the past means
-    capacity came back."""
+    capacity came back. An *invalid* file — torn/malformed JSON, staler
+    than ``CAPACITY_STALE_S``, or carrying an unknown ``owner`` — reads
+    as "no change" (``current`` when the caller supplies its view, else
+    ``full``) with a ``capacity_file_invalid`` obs point: it must never
+    crash the supervisor or silently shrink the world."""
     import json
 
     if not path:
         return full
+    fallback = full if current is None else current
+
+    def _invalid(reason: str) -> int:
+        obs.point("capacity_file_invalid", reason=reason, path=str(path))
+        return fallback
+
     try:
         with open(path) as fh:
-            d = json.load(fh)
-    except (OSError, ValueError):
+            raw = fh.read()
+    except FileNotFoundError:
         return full
-    restore_at = d.get("restore_at")
-    if restore_at is not None and time.time() >= float(restore_at):
-        return full
-    return max(min(int(d.get("available", full)), full), 0)
+    except OSError:
+        return _invalid("unreadable")
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return _invalid("malformed")
+    if not isinstance(d, dict):
+        return _invalid("malformed")
+    try:
+        stale_s = float(os.environ.get(CAPACITY_STALE_ENV, "0") or 0)
+    except ValueError:
+        stale_s = 0.0
+    if stale_s > 0:
+        try:
+            age = time.time() - os.stat(path).st_mtime
+        except OSError:
+            age = None
+        if age is not None and age > stale_s:
+            return _invalid("stale")
+    owner = d.get("owner")
+    if owner is not None and owner not in CAPACITY_OWNERS:
+        return _invalid("unknown_owner")
+    try:
+        restore_at = d.get("restore_at")
+        if restore_at is not None and time.time() >= float(restore_at):
+            return full
+        return max(min(int(d.get("available", full)), full), 0)
+    except (TypeError, ValueError):
+        return _invalid("malformed")
 
 
 # ---------------------------------------------------------------------------

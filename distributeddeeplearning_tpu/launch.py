@@ -444,7 +444,7 @@ def _grow_checker(
         if now < state["next"]:
             return None
         state["next"] = now + max(every_s, 0.1)
-        available = faults.probe_capacity(cap_file, full)
+        available = faults.probe_capacity(cap_file, full, current=cur)
         target = _elastic_world(full, available, min_world)
         if target > cur:
             return (
@@ -619,6 +619,10 @@ def launch_supervised(
                     "attempt_start", attempt=attempt, world_size=cur_world,
                     full_world=full_world if elastic else None,
                 )
+                if elastic:
+                    # Pool-ownership gauge (colocation, serving/
+                    # arbiter.py): how many pool devices training holds.
+                    sbus.gauge("pool.train_world", float(cur_world))
                 sbus.flush()
             rc = launch_local(
                 script,
@@ -652,7 +656,9 @@ def launch_supervised(
                 # Coordinated grow-back handover: capacity returned, the
                 # world was stopped at a step boundary — relaunch at the
                 # restored size with resume; no backoff, no budget.
-                available = faults.probe_capacity(cap_file, full_world)
+                available = faults.probe_capacity(
+                    cap_file, full_world, current=cur_world
+                )
                 new_world = _elastic_world(
                     full_world, available, min_world_size
                 )
@@ -688,7 +694,9 @@ def launch_supervised(
                 return faults.normalize_rc(rc)
             next_world = cur_world
             if elastic:
-                available = faults.probe_capacity(cap_file, full_world)
+                available = faults.probe_capacity(
+                    cap_file, full_world, current=cur_world
+                )
                 next_world = _elastic_world(
                     full_world, available, min_world_size
                 )

@@ -153,6 +153,12 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
     # SLO engine transitions (obs/slo.py): per-objective breach/recover
     # timeline + the worst burn rate observed at any transition.
     slo_by_obj: Dict[str, Dict[str, Any]] = {}
+    # Pool-ownership timeline (train/serve colocation, serving/
+    # arbiter.py): every arbiter decision plus every CHANGE of the
+    # pool.train_world / pool.serve_replicas gauges, wall-stamped, so
+    # the report shows who held the one device pool when.
+    pool_timeline: List[Dict[str, Any]] = []
+    pool_last: Dict[str, Any] = {}
     procs: Dict[Any, Dict[str, Any]] = {}
     # name -> epoch -> {proc: end_wall}; cross-process skew is read off
     # the per-epoch boundary (every process ends epoch k once).
@@ -186,6 +192,13 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
                 )
         elif kind == "gauge":
             gauges[name] = e.get("value")
+            if name in ("pool.train_world", "pool.serve_replicas"):
+                v = e.get("value")
+                if pool_last.get(name) != v:
+                    pool_last[name] = v
+                    pool_timeline.append(
+                        {"wall": w, "event": name, "value": v}
+                    )
             try:
                 m = gauge_means.setdefault(name, [0.0, 0])
                 m[0] += float(e.get("value", 0.0))
@@ -194,6 +207,14 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
                 pass
         elif kind == "point":
             points[name] = points.get(name, 0) + 1
+            if name.startswith("arbiter."):
+                pool_timeline.append({
+                    "wall": w, "event": name,
+                    "labels": {
+                        k: v for k, v in sorted(labels.items())
+                        if k != "path"
+                    },
+                })
             if name in ("slo_breach", "slo_recover"):
                 obj = labels.get("objective", "?")
                 entry = slo_by_obj.setdefault(
@@ -352,6 +373,9 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         entry["timeline"].sort(
             key=lambda e: (e["wall"] is None, e["wall"] or 0.0)
         )
+    pool_timeline.sort(
+        key=lambda e: (e["wall"] is None, e["wall"] or 0.0)
+    )
 
     run_ids = {m.get("run") for m in loaded["metas"].values()}
     return {
@@ -369,6 +393,7 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
         "serving": serving,
         "traces": trace_summary,
         "slo": slo_by_obj or None,
+        "pool": pool_timeline or None,
         "max_epoch_skew_ms": max(skews) if skews else 0.0,
         "epochs_seen": len(epoch_ends),
     }
@@ -587,6 +612,24 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
                         if e.get("value") is not None else ""
                     )
                 )
+    pool = summary.get("pool")
+    if pool:
+        add("")
+        add("pool ownership (arbiter timeline, serving/arbiter.py):")
+        t0s = [e["wall"] for e in pool if e["wall"] is not None]
+        pool_base = min(t0s) if t0s else 0.0
+        for e in pool:
+            when = (
+                f"+{e['wall'] - pool_base:8.3f}s"
+                if e["wall"] is not None else "<no wall>"
+            )
+            if "value" in e:
+                add(f"  {when}  {e['event']:20s}  = {e['value']}")
+            else:
+                lbls = ", ".join(
+                    f"{k}={v}" for k, v in (e.get("labels") or {}).items()
+                )
+                add(f"  {when}  {e['event']:20s}  {lbls}".rstrip())
     if summary["epochs_seen"]:
         add(f"epochs: {summary['epochs_seen']}, max cross-process "
             f"epoch-end skew: {summary['max_epoch_skew_ms']:.1f} ms")

@@ -1,0 +1,396 @@
+"""Train/serve colocation arbiter — one elastic device pool.
+
+Elastic training worlds (launch.py, docs/ROBUSTNESS.md) and the
+self-healing serving fleet (serving/fleet/) each manage their own
+hardware; production TPU pods run both on the *same* devices. The
+:class:`PoolArbiter` owns one pool and arbitrates under a declared
+priority order:
+
+* **Training holds the mesh by default.** The pool starts fully owned
+  by the supervised training world (``pool_devices`` processes).
+* **Serving escalates, never grabs.** Only when the fleet pressure
+  gauge (``serve.fleet_pressure``) and the SLO burn-rate engine
+  (obs/slo.py) sustain a breach *past* the brownout ladder — every
+  declared degradation stage applied and the burn still standing
+  (``BrownoutLadder.exhausted``) — does the arbiter shrink training:
+  it writes a reduced capacity through the existing capacity-file
+  protocol (``faults.write_capacity``, ``owner="arbiter"``), the
+  supervisor's grow/shrink poller sees it and restarts the world at
+  the largest fitting divisor (``EXIT_RESIZE``, budget-free, with the
+  BATCHSIZE/ACCUM_STEPS rescale), and the freed devices become
+  leasable.
+* **Serving *requests* capacity.** ``FleetController`` scale-up asks
+  for a lease (:meth:`request_lease`) instead of assuming free
+  hardware; a denial is ``fleet.scaleup_denied`` + backoff, not a
+  spin.
+* **Training reclaims.** When pressure drops (``grow_ticks`` calm
+  observations) or a training epoch boundary arrives
+  (:meth:`epoch_boundary`), the arbiter stops granting leases, the
+  controller drains leased replicas (zero-drop: running streams
+  finish), and once the last lease is released the arbiter restores
+  full capacity — training grows back.
+
+The escalation ladder is therefore: admission derate → brownout
+stages (shed) → shrink training. Every decision is telemetry:
+``arbiter.shrink`` / ``arbiter.grow`` / ``arbiter.reclaim`` /
+``arbiter.lease_grant`` / ``arbiter.lease_deny`` /
+``arbiter.lease_release`` / ``arbiter.lease_expired`` points plus the
+``pool.train_world`` / ``pool.serve_replicas`` ownership gauges
+(docs/OBSERVABILITY.md).
+
+Signal sources mirror the other control loops: an injected ``reader``
+(tests, drills), else the live plane's ``rollup.json``. Deliberately
+jax-free — the arbiter runs in the supervisor/controller process.
+
+Env contract (``ArbiterConfig.from_env``; docs/ORCHESTRATION.md):
+``ARBITER_POOL_DEVICES``, ``ARBITER_MIN_TRAIN_WORLD``,
+``ARBITER_DEVICES_PER_REPLICA``, ``ARBITER_SHRINK_TICKS``,
+``ARBITER_GROW_TICKS``, ``ARBITER_HIGH_PRESSURE``,
+``ARBITER_LOW_PRESSURE``, ``ARBITER_LEASE_TTL_S``,
+``ARBITER_WATCH_PREFIX``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from distributeddeeplearning_tpu import faults, obs
+from distributeddeeplearning_tpu.serving.scheduler import (
+    burning_latency_objectives,
+)
+
+
+def _shrink_target(pool: int, current: int, floor: int) -> Optional[int]:
+    """The largest divisor of ``pool`` strictly below ``current`` and no
+    smaller than ``floor`` — the next training world size down the
+    elastic ladder (mirrors launch.py ``_elastic_world``)."""
+    for d in range(current - 1, max(floor, 1) - 1, -1):
+        if pool % d == 0:
+            return d
+    return None
+
+
+@dataclasses.dataclass
+class ArbiterConfig:
+    """Pool shape + escalation/hysteresis knobs, env-overridable
+    (ARBITER_*)."""
+
+    pool_devices: int               # total devices = full training world
+    min_train_world: int = 1        # training never shrinks below this
+    devices_per_replica: int = 1    # lease quantum for one replica
+    shrink_ticks: int = 3           # exhausted+burning obs before shrink
+    grow_ticks: int = 6             # calm obs before training reclaims
+    high_pressure: float = 1.0      # fleet pressure >= this is "hot"
+    low_pressure: float = 0.35      # fleet pressure <= this is "calm"
+    lease_ttl_s: float = 600.0      # dead-holder safety net (0 = no TTL)
+    watch_prefix: Optional[str] = None  # SLO metric filter (serve.*)
+
+    def validate(self) -> None:
+        if self.pool_devices < 1:
+            raise ValueError(f"pool_devices {self.pool_devices} must be >= 1")
+        if not 1 <= self.min_train_world <= self.pool_devices:
+            raise ValueError(
+                f"need 1 <= min_train_world {self.min_train_world} <= "
+                f"pool {self.pool_devices}"
+            )
+        if self.devices_per_replica < 1:
+            raise ValueError("devices_per_replica must be >= 1")
+        if self.shrink_ticks < 1 or self.grow_ticks < 1:
+            raise ValueError("shrink_ticks and grow_ticks must be >= 1")
+        if self.low_pressure >= self.high_pressure:
+            raise ValueError(
+                f"low watermark {self.low_pressure} must be below high "
+                f"{self.high_pressure}"
+            )
+
+    @classmethod
+    def from_env(cls, env=None, **overrides: Any) -> "ArbiterConfig":
+        e = os.environ if env is None else env
+        kw: Dict[str, Any] = dict(
+            pool_devices=int(e.get("ARBITER_POOL_DEVICES", "1")),
+            min_train_world=int(e.get("ARBITER_MIN_TRAIN_WORLD", "1")),
+            devices_per_replica=int(
+                e.get("ARBITER_DEVICES_PER_REPLICA", "1")
+            ),
+            shrink_ticks=int(e.get("ARBITER_SHRINK_TICKS", "3")),
+            grow_ticks=int(e.get("ARBITER_GROW_TICKS", "6")),
+            high_pressure=float(e.get("ARBITER_HIGH_PRESSURE", "1.0")),
+            low_pressure=float(e.get("ARBITER_LOW_PRESSURE", "0.35")),
+            lease_ttl_s=float(e.get("ARBITER_LEASE_TTL_S", "600")),
+            watch_prefix=e.get("ARBITER_WATCH_PREFIX") or None,
+        )
+        kw.update(overrides)
+        cfg = cls(**kw)
+        cfg.validate()
+        return cfg
+
+
+@dataclasses.dataclass
+class Lease:
+    """One serving claim on freed pool devices."""
+
+    owner: str
+    devices: int
+    granted_at: float
+    expires_at: Optional[float]  # lease TTL (dead-holder safety net)
+
+
+class PoolArbiter:
+    """Arbitrate one device pool between training and serving.
+
+    ``tick()`` is the decision loop (call it at the controller cadence);
+    ``request_lease`` / ``release_lease`` are the controller-facing
+    capacity API; ``epoch_boundary`` is the training-side reclaim hook.
+    ``decisions`` records every transition for tests and reports.
+    """
+
+    def __init__(
+        self,
+        config: ArbiterConfig,
+        capacity_file: Optional[str] = None,
+        *,
+        reader: Optional[Callable[[], Optional[dict]]] = None,
+        snapshot_path: Optional[str] = None,
+        ladder=None,
+    ) -> None:
+        config.validate()
+        self.config = config
+        if capacity_file is None:
+            capacity_file = os.environ.get(
+                faults.CAPACITY_FILE_ENV
+            ) or os.path.join(os.environ.get("OBS_DIR", "."), "capacity.json")
+        self.capacity_file = capacity_file
+        self._reader = reader
+        if snapshot_path is None:
+            snapshot_path = os.path.join(
+                os.environ.get("OBS_DIR", "."), "rollup.json"
+            )
+        self.snapshot_path = snapshot_path
+        self.ladder = ladder
+        self.train_world = config.pool_devices  # training holds by default
+        self.leases: Dict[str, Lease] = {}
+        self.reclaiming = False
+        self._hot = 0
+        self._cool = 0
+        self.decisions: List[Dict[str, Any]] = []
+        self._gauges()
+
+    # -- pool accounting ---------------------------------------------------
+
+    @property
+    def leased_devices(self) -> int:
+        return sum(l.devices for l in self.leases.values())
+
+    @property
+    def free_devices(self) -> int:
+        """Devices freed by shrinking training and not yet leased out."""
+        return max(
+            self.config.pool_devices - self.train_world
+            - self.leased_devices, 0,
+        )
+
+    def has_lease(self, owner: str) -> bool:
+        return owner in self.leases
+
+    def _gauges(self) -> None:
+        obs.gauge("pool.train_world", float(self.train_world))
+        obs.gauge("pool.serve_replicas", float(len(self.leases)))
+
+    def _decide(self, action: str, **labels: Any) -> None:
+        self.decisions.append({"action": action, **labels})
+        obs.point(f"arbiter.{action}", **labels)
+        self._gauges()
+
+    # -- signal ------------------------------------------------------------
+
+    def _read(self) -> Optional[dict]:
+        if self._reader is not None:
+            return self._reader()
+        from distributeddeeplearning_tpu.obs.rollup import read_snapshot
+
+        return read_snapshot(self.snapshot_path)
+
+    @staticmethod
+    def _pressure(snap: dict) -> Optional[float]:
+        g = (snap.get("gauges") or {}).get("serve.fleet_pressure")
+        if g and g.get("value") is not None:
+            return float(g["value"])
+        return None
+
+    # -- decision loop -----------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One arbitration decision. Returns ``"shrink"`` (training
+        world reduced, capacity file written), ``"grow"`` (full
+        capacity restored), ``"reclaim"`` (training wants its devices
+        back; waiting on lease drains), or None."""
+        if now is None:
+            now = time.time()
+        self._expire(now)
+        snap = self._read()
+        if snap is None:
+            return None  # no plane publishing: hold current ownership
+        pressure = self._pressure(snap)
+        burning = burning_latency_objectives(snap, self.config.watch_prefix)
+        # The ladder must be exhausted before training pays: brownout →
+        # shed → shrink. With no ladder wired there is nothing left to
+        # shed, so burn alone escalates.
+        exhausted = self.ladder.exhausted if self.ladder is not None else True
+        cfg = self.config
+        hot = bool(burning) and exhausted and (
+            pressure is not None and pressure >= cfg.high_pressure
+        )
+        calm = not burning and (
+            pressure is None or pressure <= cfg.low_pressure
+        )
+        if hot:
+            self._hot += 1
+            self._cool = 0
+        elif calm:
+            self._cool += 1
+            self._hot = 0
+        else:
+            self._hot = self._cool = 0
+        if self._hot >= cfg.shrink_ticks and not self.reclaiming:
+            target = _shrink_target(
+                cfg.pool_devices, self.train_world, cfg.min_train_world
+            )
+            if (
+                target is not None
+                and self.train_world - target >= cfg.devices_per_replica
+            ):
+                return self._shrink(target, now, pressure, burning)
+        if self._cool >= cfg.grow_ticks and (
+            self.train_world < cfg.pool_devices
+        ):
+            return self._reclaim_or_grow(now, trigger="pressure_drop")
+        return None
+
+    def epoch_boundary(self, now: Optional[float] = None) -> Optional[str]:
+        """Training-side reclaim hook: an epoch boundary is a natural
+        grow-back point regardless of the pressure hysteresis (the
+        declared priority order — training holds the mesh)."""
+        if now is None:
+            now = time.time()
+        if self.train_world >= self.config.pool_devices:
+            return None
+        return self._reclaim_or_grow(now, trigger="epoch_boundary")
+
+    # -- transitions -------------------------------------------------------
+
+    def _shrink(
+        self, target: int, now: float, pressure, burning
+    ) -> str:
+        cfg = self.config
+        restore_at = now + cfg.lease_ttl_s if cfg.lease_ttl_s > 0 else None
+        faults.write_capacity(
+            self.capacity_file, target, restore_at=restore_at,
+            owner="arbiter",
+        )
+        from_world, self.train_world = self.train_world, target
+        self._hot = 0
+        self._decide(
+            "shrink", from_world=from_world, to_world=target,
+            pressure=pressure,
+            objectives=";".join(burning) if burning else "",
+        )
+        return "shrink"
+
+    def _reclaim_or_grow(self, now: float, *, trigger: str) -> str:
+        if self.leases:
+            if not self.reclaiming:
+                self.reclaiming = True
+                self._decide(
+                    "reclaim", trigger=trigger,
+                    leases=len(self.leases),
+                )
+            return "reclaim"
+        return self._grow(trigger=trigger)
+
+    def _grow(self, *, trigger: str) -> str:
+        faults.write_capacity(
+            self.capacity_file, self.config.pool_devices, owner="arbiter"
+        )
+        from_world, self.train_world = (
+            self.train_world, self.config.pool_devices
+        )
+        self.reclaiming = False
+        self._cool = 0
+        self._decide(
+            "grow", from_world=from_world,
+            to_world=self.train_world, trigger=trigger,
+        )
+        return "grow"
+
+    # -- lease API (FleetController scale-up) ------------------------------
+
+    def request_lease(
+        self,
+        owner: str,
+        devices: Optional[int] = None,
+        now: Optional[float] = None,
+    ) -> bool:
+        """Grant ``devices`` freed-pool devices to ``owner`` (one
+        replica's claim). Denied while training is reclaiming (priority
+        order) or when the freed share is exhausted."""
+        if now is None:
+            now = time.time()
+        if devices is None:
+            devices = self.config.devices_per_replica
+        if owner in self.leases:
+            return True  # idempotent: the claim is already held
+        if self.reclaiming:
+            self._decide(
+                "lease_deny", owner=owner, devices=devices,
+                reason="reclaiming",
+            )
+            return False
+        if devices > self.free_devices:
+            self._decide(
+                "lease_deny", owner=owner, devices=devices,
+                reason="exhausted", free=self.free_devices,
+            )
+            return False
+        ttl = self.config.lease_ttl_s
+        self.leases[owner] = Lease(
+            owner=owner, devices=devices, granted_at=now,
+            expires_at=now + ttl if ttl > 0 else None,
+        )
+        self._decide(
+            "lease_grant", owner=owner, devices=devices,
+            free=self.free_devices,
+        )
+        return True
+
+    def release_lease(self, owner: str) -> bool:
+        """Return ``owner``'s devices to the pool (the controller calls
+        this when a leased replica finishes draining — zero-drop). If
+        training was reclaiming and this was the last lease, capacity
+        restores immediately."""
+        lease = self.leases.pop(owner, None)
+        if lease is None:
+            return False
+        self._decide(
+            "lease_release", owner=owner, devices=lease.devices,
+            free=self.free_devices,
+        )
+        if self.reclaiming and not self.leases:
+            self._grow(trigger="last_lease_released")
+        return True
+
+    def _expire(self, now: float) -> None:
+        """Reap leases past their TTL — a dead holder must not pin
+        freed devices forever."""
+        for owner in [
+            o for o, l in self.leases.items()
+            if l.expires_at is not None and now >= l.expires_at
+        ]:
+            lease = self.leases.pop(owner)
+            self._decide(
+                "lease_expired", owner=owner, devices=lease.devices,
+            )
+        if self.reclaiming and not self.leases:
+            self._grow(trigger="last_lease_expired")

@@ -49,6 +49,10 @@ class ControllerConfig:
     # the same failure. Scale-up is held for this many router ticks
     # after the most recent breaker opening (0 = never hold).
     breaker_block_ticks: int = 10
+    # Hardware is NOT infinite: when scale-up is denied (breaker
+    # cooldown or an arbiter lease refusal) the controller backs off
+    # for this many router ticks instead of re-asking every tick.
+    denied_backoff_ticks: int = 10
 
     def validate(self) -> None:
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -83,6 +87,7 @@ class FleetController:
         reader: Optional[Callable[[], Optional[float]]] = None,
         snapshot_path: Optional[str] = None,
         threaded_replicas: bool = True,
+        arbiter=None,
     ) -> None:
         self.router = router
         self.factory = factory
@@ -91,8 +96,13 @@ class FleetController:
         self._reader = reader
         self.snapshot_path = snapshot_path
         self.threaded_replicas = threaded_replicas
+        # Colocation (serving/arbiter.py): when an arbiter owns the
+        # pool, every scale-up must hold a lease on freed devices —
+        # the controller asks, it does not assume free hardware.
+        self.arbiter = arbiter
         self._hot = 0
         self._cold = 0
+        self._denied_until: Optional[int] = None
         self.actions: List[Dict[str, Any]] = []
 
     # -- signal ------------------------------------------------------------
@@ -122,12 +132,31 @@ class FleetController:
     def tick(self) -> Optional[str]:
         """One control decision. Finalizes any replica that finished
         draining (remove), then applies the watermark hysteresis."""
-        # Finalize drains the policy started earlier.
+        # Finalize drains the policy started earlier. A leased replica's
+        # devices return to the arbiter only once the drain completed —
+        # zero-drop: running streams finished, nothing was cut mid-air.
         for r in list(self.router.replicas):
             if r.state == "drained":
                 self.router.remove_replica(r.rid)
+                if self.arbiter is not None:
+                    self.arbiter.release_lease(f"replica:{r.rid}")
                 self._record("remove", r.rid)
                 return "remove"
+        # Training reclaim (priority order, docs/ROBUSTNESS.md): when
+        # the arbiter wants its devices back, drain one leased replica
+        # per tick regardless of the pressure hysteresis.
+        if self.arbiter is not None and self.arbiter.reclaiming:
+            for r in self.router.replicas:
+                if r.state == "ready" and self.arbiter.has_lease(
+                    f"replica:{r.rid}"
+                ):
+                    self.router.drain_replica(r.rid)
+                    self._record("drain", r.rid, reason="reclaim")
+                    obs.point(
+                        "fleet.scale_down", replica=r.rid,
+                        reason="reclaim",
+                    )
+                    return "drain"
         p = self.read_pressure()
         if p is None:
             return None
@@ -142,6 +171,13 @@ class FleetController:
             self._hot = self._cold = 0
         ready = self._ready_count()
         if self._hot >= cfg.up_ticks and ready < cfg.max_replicas:
+            # Backing off after a denial: do not re-ask (and re-emit)
+            # every tick — that is the spin this guard exists to stop.
+            if (
+                self._denied_until is not None
+                and self.router._ticks < self._denied_until
+            ):
+                return None
             # Respect open breakers: right after a replica crash-looped
             # through its restart budget, hold scale-up for a cooldown
             # window instead of feeding the same failure more capacity.
@@ -153,17 +189,22 @@ class FleetController:
                 and last is not None
                 and self.router._ticks - last < cfg.breaker_block_ticks
             ):
-                obs.point(
-                    "fleet.scale_up_blocked", pressure=round(p, 4),
-                    breaker_tick=last,
-                )
+                self._deny("breaker", p, breaker_tick=last)
                 return None
             rid = self.router.next_rid()
+            # Colocated pool: the arbiter must lease the devices first
+            # — hardware is whatever training has actually freed.
+            if self.arbiter is not None and not self.arbiter.request_lease(
+                f"replica:{rid}"
+            ):
+                self._deny("lease", p, replica=rid)
+                return None
             self.router.add_replica(
                 self.factory(rid), start=True,
                 threaded=self.threaded_replicas,
             )
             self._hot = 0
+            self._denied_until = None
             self._record("scale_up", rid, pressure=p)
             obs.point("fleet.scale_up", replica=rid, pressure=round(p, 4))
             return "scale_up"
@@ -192,6 +233,22 @@ class FleetController:
                 r.server.active_count + r.server.queued_count
                 if r.server is not None else 0
             ),
+        )
+
+    def _deny(self, reason: str, pressure: float, **labels: Any) -> None:
+        """Scale-up refused (breaker cooldown / arbiter lease): emit
+        one ``fleet.scaleup_denied`` and enter a tick-counted backoff
+        instead of re-asking every tick."""
+        self._denied_until = (
+            self.router._ticks + self.config.denied_backoff_ticks
+        )
+        self.actions.append({
+            "action": "scaleup_denied", "reason": reason,
+            "pressure": pressure, **labels,
+        })
+        obs.point(
+            "fleet.scaleup_denied", reason=reason,
+            pressure=round(pressure, 4), **labels,
         )
 
     def _record(self, action: str, rid: int, **extra: Any) -> None:

@@ -319,7 +319,18 @@ class BrownoutLadder:
         self._hot = 0
         self._cool = 0
         self._next_read = 0.0
+        self._last_burning = False
         self.transitions: List[Dict[str, Any]] = []
+
+    @property
+    def exhausted(self) -> bool:
+        """Every declared stage is applied and the last observation was
+        still burning — shedding alone did not recover the SLO. This is
+        the signal the colocation arbiter escalates on: the pool only
+        shrinks *training* after the serving-side ladder has been
+        walked to the bottom (brownout → shed → shrink,
+        docs/ROBUSTNESS.md)."""
+        return self.level >= len(self.stages) and self._last_burning
 
     def _read(self) -> Optional[dict]:
         if self._reader is not None:
@@ -339,6 +350,7 @@ class BrownoutLadder:
         if snap is None:
             return None  # no plane publishing: hold the current level
         burning = burning_latency_objectives(snap, self.watch_prefix)
+        self._last_burning = bool(burning)
         if burning:
             self._hot += 1
             self._cool = 0

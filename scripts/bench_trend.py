@@ -20,7 +20,11 @@ tier count as ``spec_k=0``), pairs whose ``data_format`` changed
 ``data_change`` skip; records predating the streamed tier count as the
 native synthetic reader), pairs whose ``chaos_plan`` differs (a
 fault storm is part of the protocol — ``chaos_change`` skip;
-chaos-free records normalize to no plan), and pairs whose
+chaos-free records normalize to no plan), pairs whose ``coloc``
+knob string differs (a re-arbitrated pool — different geometry,
+shrink step, or surge window — is a new colocation protocol —
+``coloc_change`` skip; non-colocated records normalize to none),
+and pairs whose
 ``decode_kernel`` changed (the fused Pallas decode path vs the stitched
 XLA lowering is a different machine program per token —
 ``kernel_change`` skip; records predating the kernel tier count as the
@@ -151,6 +155,13 @@ def analyze(
             # chaos-plan difference is a protocol skip, never a
             # regression. Chaos-free records normalize to "".
             "chaos": str(detail.get("chaos_plan") or ""),
+            # The colocation knob string (pool geometry, shrink step,
+            # brownout stages, surge window — coloc_bench's `coloc`
+            # detail) re-shapes the arbitrated storm the same way: a
+            # different arbitration protocol is a new baseline
+            # (``coloc_change`` skip), never a regression.
+            # Non-colocated records normalize to "".
+            "coloc": str(detail.get("coloc") or ""),
             # An elastic world resize is the training-side analog: the
             # same metric over a different device count is a new
             # baseline (``world_change`` skip). Pre-elastic records
@@ -178,6 +189,7 @@ def analyze(
                 and prev["world"] == row["world"]
                 and prev["data_format"] == row["data_format"]
                 and prev["chaos"] == row["chaos"]
+                and prev["coloc"] == row["coloc"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -222,6 +234,11 @@ def analyze(
                     f"chaos_change:"
                     f"{prev['chaos'] or 'none'}->{row['chaos'] or 'none'}"
                 )
+            elif prev is not None and prev["coloc"] != row["coloc"]:
+                row["skip"] = (
+                    f"coloc_change:"
+                    f"{prev['coloc'] or 'none'}->{row['coloc'] or 'none'}"
+                )
             elif prev is not None:
                 row["skip"] = (
                     f"world_change:{prev['world'] or 'unspecified'}"
@@ -240,6 +257,7 @@ def analyze(
                     "world": row["world"],
                     "data_format": row["data_format"],
                     "chaos": row["chaos"],
+                    "coloc": row["coloc"],
                 }
         rows.append(row)
     return {

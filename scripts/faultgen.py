@@ -22,20 +22,31 @@ injector) and ``docs/ROBUSTNESS.md`` (failure model). Three actions:
   (``SERVE_CHAOS_PLAN``, ``serving/chaos.py``): one directive per
   ``--verbs`` entry over ``--replicas`` replicas, ticks drawn from
   ``--storm-seed`` — the plan ``scripts/chaos_bench.py`` replays.
+* ``coloc-drill`` — emit the paired surge/shrink/storm/restore recipe
+  for the train/serve colocation drill (``serving/arbiter.py``,
+  ``scripts/coloc_bench.py``): a training-side ``FAULT_PLAN``
+  (``shrink`` preemption + capacity restore) and a seeded serving-side
+  ``SERVE_CHAOS_PLAN`` storm, one ``KEY=plan`` line each — the
+  combined file ``validate`` understands.
 
 ``validate`` speaks BOTH dialects: a plan whose directives carry
 ``tick=`` (or use the fleet verbs crash/slow/corrupt/flap) validates
 against the serving chaos grammar; everything else against the
-training ``FAULT_PLAN`` grammar.
+training ``FAULT_PLAN`` grammar. A *combined* plan — ``KEY=plan``
+lines (``coloc-drill`` output, also accepted as a file path) or one
+``;``-joined string mixing both dialects — is split per directive and
+each subset validated against its own grammar.
 
 Usage::
 
     python scripts/faultgen.py validate "kill:step=3,rank=1;nan:step=2"
     python scripts/faultgen.py validate "crash:tick=4,replica=0;slow:tick=6,replica=1,factor=6"
+    python scripts/faultgen.py validate combined_plan.txt
     python scripts/faultgen.py corrupt-latest /path/to/model_dir
     python scripts/faultgen.py exit-codes
     python scripts/faultgen.py elastic-drill --step 3 --restore-step 6
     python scripts/faultgen.py chaos-drill --replicas 2 --storm-seed 7
+    python scripts/faultgen.py coloc-drill --replicas 2 --storm-seed 7
 """
 
 import argparse
@@ -86,20 +97,62 @@ def _print_fleet_plan(plan) -> None:
         )
 
 
-def _cmd_validate(args) -> int:
-    if _is_fleet_plan(args.plan):
-        try:
-            plan = chaos.parse_chaos_plan(args.plan)
-        except ValueError as e:
-            print(f"invalid SERVE_CHAOS_PLAN: {e}", file=sys.stderr)
-            return 2
-        if not plan:
-            print("empty plan (no faults)")
-            return 0
-        _print_fleet_plan(plan)
-        return 0
+def _split_dialects(text: str):
+    """Split a (possibly combined) plan into ``(fault_text,
+    fleet_text)``. Handles the ``coloc-drill`` output — ``FAULT_PLAN=``
+    / ``SERVE_CHAOS_PLAN=`` lines — and a single ``;``-joined string
+    mixing directives of both dialects (per-directive sniff)."""
+    fault_parts, fleet_parts = [], []
+    keyed = False
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if line.startswith("FAULT_PLAN="):
+            fault_parts.append(line.partition("=")[2])
+            keyed = True
+        elif line.startswith("SERVE_CHAOS_PLAN="):
+            fleet_parts.append(line.partition("=")[2])
+            keyed = True
+    if not keyed:
+        for raw in (text or "").replace("\n", ";").split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            (fleet_parts if _is_fleet_plan(raw) else fault_parts).append(raw)
+    return ";".join(fault_parts), ";".join(fleet_parts)
+
+
+def _validate_fleet_text(text: str) -> int:
     try:
-        plan = faults.parse_fault_plan(args.plan)
+        plan = chaos.parse_chaos_plan(text)
+    except ValueError as e:
+        print(f"invalid SERVE_CHAOS_PLAN: {e}", file=sys.stderr)
+        return 2
+    if not plan:
+        print("empty plan (no faults)")
+        return 0
+    _print_fleet_plan(plan)
+    return 0
+
+
+def _cmd_validate(args) -> int:
+    text = args.plan
+    if text and os.path.isfile(text):
+        # A combined plan file (coloc-drill output saved to disk).
+        with open(text) as fh:
+            text = fh.read()
+    fault_text, fleet_text = _split_dialects(text)
+    if fault_text and fleet_text:
+        print("combined plan (both dialects):")
+        rc = _validate_fault_text(fault_text)
+        return rc or _validate_fleet_text(fleet_text)
+    if fleet_text and not fault_text:
+        return _validate_fleet_text(fleet_text)
+    return _validate_fault_text(fault_text or text)
+
+
+def _validate_fault_text(text: str) -> int:
+    try:
+        plan = faults.parse_fault_plan(text)
     except ValueError as e:
         print(f"invalid FAULT_PLAN: {e}", file=sys.stderr)
         return 2
@@ -194,6 +247,39 @@ def _cmd_chaos_drill(args) -> int:
             f"#       SERVE_REPLICAS={args.replicas} "
             f"SERVE_CHAOS_SEED={args.storm_seed} \\\n"
             "#       python scripts/chaos_bench.py",
+            file=sys.stderr,
+        )
+    return 0
+
+
+def _cmd_coloc_drill(args) -> int:
+    """Emit (and validate) the paired colocation recipe: a training
+    shrink/restore FAULT_PLAN and a seeded serving storm
+    SERVE_CHAOS_PLAN — the surge that shrinks training, the storm the
+    fleet self-heals through, and the restore that grows it back."""
+    if args.restore_step is not None:
+        restore = f"restore_capacity:step={args.restore_step}"
+    else:
+        restore = f"restore_capacity:secs={args.restore_secs:g}"
+    fault_plan = f"shrink:step={args.shrink_step},ranks={args.ranks};{restore}"
+    verbs = tuple(v.strip() for v in args.verbs.split(",") if v.strip())
+    try:
+        faults.parse_fault_plan(fault_plan)
+        chaos_plan = chaos.storm_plan(
+            args.replicas, seed=args.storm_seed, verbs=verbs,
+        )
+    except ValueError as e:
+        print(f"invalid drill spec: {e}", file=sys.stderr)
+        return 2
+    print(f"FAULT_PLAN={fault_plan}")
+    print(f"SERVE_CHAOS_PLAN={chaos_plan}")
+    if args.verbose:
+        print(
+            "# replay the combined storm through the gated bench, e.g.:\n"
+            f"#   FAULT_PLAN='{fault_plan}' \\\n"
+            f"#       SERVE_CHAOS_PLAN='{chaos_plan}' \\\n"
+            f"#       SERVE_CHAOS_SEED={args.storm_seed} \\\n"
+            "#       python scripts/coloc_bench.py",
             file=sys.stderr,
         )
     return 0
@@ -295,6 +381,47 @@ def main(argv=None) -> int:
         help="also print the chaos_bench invocation recipe to stderr",
     )
     k.set_defaults(fn=_cmd_chaos_drill)
+
+    x = sub.add_parser(
+        "coloc-drill",
+        help="emit the paired FAULT_PLAN + SERVE_CHAOS_PLAN colocation "
+        "recipe (serving/arbiter.py; scripts/coloc_bench.py)",
+    )
+    x.add_argument(
+        "--shrink-step", type=int, default=6,
+        help="global step after which training's shrink preemption fires",
+    )
+    x.add_argument(
+        "--ranks", type=int, default=1,
+        help="training processes freed for serving by the shrink",
+    )
+    x.add_argument(
+        "--restore-step", type=int, default=None,
+        help="global step at which capacity restores (deterministic; "
+        "wins over --restore-secs)",
+    )
+    x.add_argument(
+        "--restore-secs", type=float, default=30.0,
+        help="wall-clock seconds until capacity returns (default 30)",
+    )
+    x.add_argument(
+        "--replicas", type=int, default=2,
+        help="fleet size the serving storm targets (default 2)",
+    )
+    x.add_argument(
+        "--storm-seed", type=int, default=0,
+        help="seed drawing the storm ticks/targets (default 0)",
+    )
+    x.add_argument(
+        "--verbs", default=",".join(chaos.FLEET_FAULT_KINDS),
+        help="comma-separated fleet verbs for the storm "
+        f"(default: {','.join(chaos.FLEET_FAULT_KINDS)})",
+    )
+    x.add_argument(
+        "--verbose", action="store_true",
+        help="also print the coloc_bench invocation recipe to stderr",
+    )
+    x.set_defaults(fn=_cmd_coloc_drill)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -1,7 +1,7 @@
 """Keep ``tests/heavy_tests.txt`` (and the TESTING.md tier table) honest.
 
 The fast/full test split is data: ``heavy_tests.txt`` lists nodeids
-measured >= ~25 s, and ``conftest`` tags them ``heavy``+``slow`` at
+measured >= ~10 s, and ``conftest`` tags them ``heavy``+``slow`` at
 collection. Two ways that data rots (VERDICT r5 items 5/7): tests get
 renamed/removed and the list keeps stale nodeids, and the TESTING.md
 tier table's collected/deselected counts drift from reality. This
@@ -99,7 +99,10 @@ def main(argv=None) -> int:
         "--from-durations", metavar="LOG", default=None,
         help="regenerate the whole list from a measured --durations log",
     )
-    p.add_argument("--threshold", type=float, default=25.0)
+    # 10 s on the 1-vCPU reference host: the fast tier must fit the
+    # driver's 870 s tier-1 timeout with margin; the suite outgrew the
+    # original 25 s cut (613 fast tests measured 1131 s total).
+    p.add_argument("--threshold", type=float, default=10.0)
     p.add_argument(
         "--check", action="store_true",
         help="don't write; exit 1 if the list on disk is stale",

@@ -174,6 +174,23 @@ def render(snapshot: dict) -> str:
         add("fleet health: " + "  ".join(
             f"{label} {v:.0f}" for label, v in health
         ))
+    # Pool-ownership row (train/serve colocation, serving/arbiter.py +
+    # docs/ROBUSTNESS.md colocation): who holds the ONE device pool
+    # right now — training's world size vs the replicas serving holds
+    # leases for. Rendered whenever an arbiter publishes the gauges.
+    pool = []
+    for label, name in (
+        ("train world", "pool.train_world"),
+        ("serve replicas", "pool.serve_replicas"),
+    ):
+        cell = (gauges or {}).get(name)
+        if cell is not None and cell.get("value") is not None:
+            pool.append((label, cell["value"]))
+    if pool:
+        add("")
+        add("pool ownership: " + "  ".join(
+            f"{label} {v:.0f}" for label, v in pool
+        ))
     # Trace-plane row (obs/traces.py): distinct request traces active
     # in the window + chaos re-routes by cause. Absent on untraced runs.
     tr = snapshot.get("traces")

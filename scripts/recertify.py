@@ -34,6 +34,10 @@ Protocols (all via bench.py's existing modes — no new measurement code):
                     corrupt/flap) + brownout ladder:
                     splice parity, corrupt healed,
                     breaker budget, bounded TTFT
+    lm_coloc        coloc_bench train/serve pool       tokens/sec
+                    arbitration under a combined
+                    fault+chaos storm: ULP re-join,
+                    zero-drop, lease/capacity cycle
     lm_stream       stream_bench pretrain-on-shards    tokens/sec
                     (streamed reader, cursor manifest)
                     -> restore -> SlotEngine greedy
@@ -175,6 +179,27 @@ PROTOCOLS = {
         "SERVE_RATE_RPS": "0", "SERVE_BUCKETS": "8,16",
         "SERVE_CHAOS_SEED": "0",
     },
+    # Colocation tier (docs/ROBUSTNESS.md colocation): ONE device pool
+    # shared by training and serving under a combined fault+chaos storm
+    # — a serving surge drives the brownout ladder to exhaustion, the
+    # PoolArbiter shrinks training through the capacity file
+    # (owner="arbiter"), the FleetController's scale-up is lease-gated
+    # (denied -> backoff, granted -> second replica), then reclaim
+    # drains the leased replica zero-drop and training grows back; the
+    # script exits non-zero unless the training trajectory re-joins the
+    # uninterrupted reference at f32 ULP, serving p99 TTFT holds the
+    # COLOC_TTFT_SLO_MS bound, every request completes with bitwise
+    # stream parity (zero dropped, zero mixed-version), program sets
+    # stay closed, and the full shrink -> lease -> reclaim -> grow
+    # cycle is observed with the capacity file round-tripping.
+    "lm_coloc": {
+        "_script": "scripts/coloc_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "64",
+        "SERVE_REQUESTS": "24", "SERVE_MAX_NEW": "12",
+        "SERVE_TENANT_WEIGHTS": "gold:3,silver:2,bronze:1",
+        "SERVE_CHAOS_SEED": "0",
+        "COLOC_POOL_DEVICES": "8", "COLOC_SHRINK_STEP": "6",
+    },
     # Streamed data plane + the first pretrain->serve artifact
     # (docs/DATA.md): pretrain lm_tiny on seeded token shards through
     # the stream reader (checkpointable shuffle cursor + host prefetch),
@@ -243,6 +268,16 @@ _PROTOCOL_VARS = (
     "STREAM_SHARD_RECORDS", "STREAM_SHUFFLE_BLOCK", "STREAM_BATCH",
     "STREAM_EPOCHS", "SERVE_PROMPT_LEN",
     "PREFETCH_HOST_BATCHES", "DATA_FORMAT", "DATA_TOPOLOGY",
+    # Colocation arbiter plane (lm_coloc row, serving/arbiter.py +
+    # docs/ROBUSTNESS.md colocation): a leaked pool geometry or stale
+    # capacity TTL must never arbitrate the other rows' devices.
+    "COLOC_POOL_DEVICES", "COLOC_SHRINK_STEP", "COLOC_TTFT_SLO_MS",
+    "COLOC_BROWNOUT_STAGES", "COLOC_SURGE_WINDOW",
+    "ARBITER_POOL_DEVICES", "ARBITER_MIN_TRAIN_WORLD",
+    "ARBITER_DEVICES_PER_REPLICA", "ARBITER_SHRINK_TICKS",
+    "ARBITER_GROW_TICKS", "ARBITER_HIGH_PRESSURE",
+    "ARBITER_LOW_PRESSURE", "ARBITER_LEASE_TTL_S",
+    "ARBITER_WATCH_PREFIX", "CAPACITY_STALE_S",
 )
 
 

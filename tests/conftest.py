@@ -27,8 +27,8 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 # Data-driven fast/full split (round 5): tests/heavy_tests.txt lists the
-# nodeids measured ≥ ~25 s on the 1-vCPU reference host (regenerate from
-# a full `pytest --durations=40` run). `make test-fast` deselects them
+# nodeids measured ≥ ~10 s on the 1-vCPU reference host (regenerate from
+# a full `pytest --durations=0` run). `make test-fast` deselects them
 # with `-m "not heavy"`; the full suite runs everything.
 _HEAVY_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "heavy_tests.txt")

@@ -1,0 +1,529 @@
+"""Train/serve colocation arbiter oracles (serving/arbiter.py).
+
+All jax-free (the arbiter runs in the supervisor/controller process):
+
+* the divisor shrink ladder + the ARBITER_* env contract;
+* shrink gating — the brownout ladder must be EXHAUSTED and the burn
+  sustained before training pays (brownout → shed → shrink, the
+  declared escalation order of docs/ROBUSTNESS.md);
+* the lease API — grant/deny/idempotency, the reclaim priority
+  (training reclaiming denies new leases), the TTL reaper;
+* grow-back hysteresis (calm ticks) and the epoch-boundary reclaim
+  hook, with zero-drop sequencing: capacity only restores after the
+  LAST lease returns;
+* the hardened capacity-file probe — torn/empty/malformed/stale/
+  unknown-owner files read as "no change" (never a surprise resize)
+  with a ``capacity_file_invalid`` obs point;
+* the faultgen ``coloc-drill`` generator + combined-plan ``validate``;
+* bench_trend's ``coloc_change`` protocol skip.
+
+The heavy combined fault+chaos storm drill (``make coloc-bench``:
+serving surge → ladder exhaustion → arbiter shrink → lease-gated
+scale-up → reclaim → zero-drop drain → grow, certified against an
+uninterrupted training reference at f32 ULP) runs the real script and
+is registered in ``tests/heavy_tests.txt``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributeddeeplearning_tpu import faults, obs
+from distributeddeeplearning_tpu.serving.arbiter import (
+    ArbiterConfig,
+    PoolArbiter,
+    _shrink_target,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snap(pressure=None, burning=False):
+    """A synthetic rollup snapshot: the fleet-pressure gauge plus an
+    (optionally burning) latency SLO row — the two signals the arbiter
+    arbitrates on."""
+    return {
+        "gauges": {"serve.fleet_pressure": {"value": pressure}},
+        "slo": [
+            {"objective": "ttft", "stat": "p99", "metric": "serve.ttft",
+             "burning": bool(burning)}
+        ],
+    }
+
+
+class _Ladder:
+    """Stand-in brownout ladder with a settable ``exhausted`` verdict."""
+
+    def __init__(self, exhausted=True):
+        self.exhausted = exhausted
+
+
+def _arbiter(tmp_path, ladder=None, reader=None, **cfg):
+    kw = dict(
+        pool_devices=8, min_train_world=2, devices_per_replica=4,
+        shrink_ticks=2, grow_ticks=3,
+    )
+    kw.update(cfg)
+    return PoolArbiter(
+        ArbiterConfig(**kw), str(tmp_path / "capacity.json"),
+        reader=reader, ladder=ladder,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shrink ladder + config contract
+# ---------------------------------------------------------------------------
+
+def test_shrink_target_walks_the_divisor_ladder():
+    assert _shrink_target(8, 8, 1) == 4
+    assert _shrink_target(8, 4, 1) == 2
+    assert _shrink_target(8, 2, 1) == 1
+    assert _shrink_target(8, 2, 2) is None     # floor reached
+    assert _shrink_target(8, 8, 5) is None     # no divisor >= floor
+    assert _shrink_target(6, 6, 1) == 3        # non-power-of-two pools
+    assert _shrink_target(6, 3, 1) == 2
+
+
+def test_arbiter_config_env_contract_and_validation():
+    cfg = ArbiterConfig.from_env({
+        "ARBITER_POOL_DEVICES": "8",
+        "ARBITER_MIN_TRAIN_WORLD": "4",
+        "ARBITER_DEVICES_PER_REPLICA": "4",
+        "ARBITER_SHRINK_TICKS": "5",
+        "ARBITER_GROW_TICKS": "9",
+        "ARBITER_HIGH_PRESSURE": "1.5",
+        "ARBITER_LOW_PRESSURE": "0.2",
+        "ARBITER_LEASE_TTL_S": "120",
+        "ARBITER_WATCH_PREFIX": "serve.",
+    })
+    assert cfg.pool_devices == 8 and cfg.min_train_world == 4
+    assert cfg.shrink_ticks == 5 and cfg.grow_ticks == 9
+    assert cfg.lease_ttl_s == 120.0 and cfg.watch_prefix == "serve."
+    # overrides beat env
+    assert ArbiterConfig.from_env(
+        {"ARBITER_POOL_DEVICES": "8"}, pool_devices=4
+    ).pool_devices == 4
+    with pytest.raises(ValueError):
+        ArbiterConfig(pool_devices=0).validate()
+    with pytest.raises(ValueError):
+        ArbiterConfig(pool_devices=4, min_train_world=5).validate()
+    with pytest.raises(ValueError):
+        ArbiterConfig(
+            pool_devices=4, high_pressure=0.3, low_pressure=0.5
+        ).validate()
+
+
+# ---------------------------------------------------------------------------
+# Shrink gating: ladder exhaustion + sustained burn
+# ---------------------------------------------------------------------------
+
+def test_shrink_waits_for_ladder_exhaustion(tmp_path):
+    """Burn + pressure alone never shrink training while the brownout
+    ladder still has stages to apply — serving degrades itself first."""
+    ladder = _Ladder(exhausted=False)
+    arb = _arbiter(
+        tmp_path, ladder=ladder,
+        reader=lambda: _snap(pressure=2.0, burning=True),
+    )
+    for _ in range(10):
+        assert arb.tick(now=0.0) is None
+    assert arb.train_world == 8 and not arb.decisions
+    ladder.exhausted = True
+    t = time.time()
+    assert arb.tick(now=t) is None            # 1st exhausted+hot obs
+    assert arb.tick(now=t) == "shrink"        # 2nd: shrink_ticks met
+    assert arb.train_world == 4
+    d = arb.decisions[-1]
+    assert d["action"] == "shrink"
+    assert d["from_world"] == 8 and d["to_world"] == 4
+    assert d["objectives"] == "ttft"
+    # the capacity file carries the arbiter's reduction + TTL safety net
+    cap = str(tmp_path / "capacity.json")
+    rec = json.loads(open(cap).read())
+    assert rec == {
+        "available": 4, "restore_at": pytest.approx(t + 600.0),
+        "owner": "arbiter",
+    }
+    assert faults.probe_capacity(cap, 8) == 4
+
+
+def test_hot_streak_resets_on_intervening_calm(tmp_path):
+    snaps = iter([
+        _snap(2.0, True), _snap(0.1, False), _snap(2.0, True),
+        _snap(2.0, True),
+    ])
+    arb = _arbiter(tmp_path, ladder=_Ladder(True),
+                   reader=lambda: next(snaps))
+    assert arb.tick(now=0.0) is None
+    assert arb.tick(now=0.0) is None          # calm tick resets the streak
+    assert arb.tick(now=0.0) is None
+    assert arb.tick(now=0.0) == "shrink"      # two fresh hot ticks
+
+
+def test_shrink_respects_floor_and_replica_quantum(tmp_path):
+    # min_train_world == pool: there is nothing to give
+    arb = _arbiter(
+        tmp_path, ladder=_Ladder(True), min_train_world=8,
+        reader=lambda: _snap(2.0, True),
+    )
+    for _ in range(5):
+        assert arb.tick(now=0.0) is None
+    assert arb.train_world == 8
+    # a shrink that frees less than one replica quantum is pointless
+    arb = _arbiter(
+        tmp_path, ladder=_Ladder(True), min_train_world=4,
+        devices_per_replica=8, reader=lambda: _snap(2.0, True),
+    )
+    for _ in range(5):
+        assert arb.tick(now=0.0) is None
+    assert arb.train_world == 8
+
+
+# ---------------------------------------------------------------------------
+# Lease API: grant/deny/priority/TTL
+# ---------------------------------------------------------------------------
+
+def _shrunk(tmp_path, **cfg):
+    arb = _arbiter(
+        tmp_path, ladder=_Ladder(True),
+        reader=lambda: _snap(2.0, True), **cfg,
+    )
+    # real wall clock: the shrink write stamps restore_at = now + TTL,
+    # and the probe treats a past restore_at as "capacity came back"
+    t = time.time()
+    arb.tick(now=t)
+    assert arb.tick(now=t) == "shrink"
+    return arb
+
+
+def test_lease_grant_deny_and_idempotency(tmp_path):
+    arb = _shrunk(tmp_path)
+    assert arb.free_devices == 4
+    assert arb.request_lease("replica:1", now=0.0) is True
+    assert arb.free_devices == 0 and arb.leased_devices == 4
+    assert arb.has_lease("replica:1")
+    # freed share exhausted: the next claim is denied, with telemetry
+    assert arb.request_lease("replica:2", now=0.0) is False
+    deny = arb.decisions[-1]
+    assert deny["action"] == "lease_deny"
+    assert deny["reason"] == "exhausted" and deny["free"] == 0
+    # re-asking for a held lease is idempotent, not a second claim
+    assert arb.request_lease("replica:1", now=0.0) is True
+    assert len(arb.leases) == 1
+    assert arb.release_lease("replica:1") is True
+    assert arb.free_devices == 4
+    assert arb.release_lease("replica:1") is False  # already returned
+
+
+def test_reclaim_denies_new_leases_until_grow(tmp_path):
+    """Priority order: once training wants its devices back, serving
+    gets nothing new; the last release restores capacity immediately."""
+    hot = [_snap(2.0, True)] * 2
+    calm = [_snap(0.1, False)] * 10
+    snaps = iter(hot + calm)
+    arb = _arbiter(tmp_path, ladder=_Ladder(True),
+                   reader=lambda: next(snaps))
+    arb.tick(now=0.0)
+    assert arb.tick(now=0.0) == "shrink"
+    assert arb.request_lease("replica:1", now=0.0)
+    # calm ticks: grow_ticks (3) consecutive calm obs -> reclaim (a
+    # lease is outstanding, so capacity cannot restore yet)
+    assert arb.tick(now=0.0) is None
+    assert arb.tick(now=0.0) is None
+    assert arb.tick(now=0.0) == "reclaim"
+    assert arb.reclaiming
+    assert [d["action"] for d in arb.decisions].count("reclaim") == 1
+    assert arb.tick(now=0.0) == "reclaim"     # held, not re-announced
+    assert [d["action"] for d in arb.decisions].count("reclaim") == 1
+    assert arb.request_lease("replica:2", now=0.0) is False
+    assert arb.decisions[-1]["reason"] == "reclaiming"
+    # zero-drop sequencing: the drain finishes, the lease returns, and
+    # ONLY then does full capacity restore
+    assert arb.release_lease("replica:1") is True
+    assert not arb.reclaiming and arb.train_world == 8
+    grow = arb.decisions[-1]
+    assert grow["action"] == "grow"
+    assert grow["trigger"] == "last_lease_released"
+    assert faults.probe_capacity(str(tmp_path / "capacity.json"), 8) == 8
+
+
+def test_epoch_boundary_reclaims_regardless_of_pressure(tmp_path):
+    arb = _shrunk(tmp_path)
+    assert arb.request_lease("replica:1", now=0.0)
+    # pressure is still hot — the epoch boundary reclaims anyway
+    assert arb.epoch_boundary(now=0.0) == "reclaim"
+    assert arb.reclaiming
+    arb.release_lease("replica:1")
+    assert arb.train_world == 8
+    assert arb.epoch_boundary(now=0.0) is None  # full world: no-op
+    # without leases outstanding the boundary grows immediately
+    arb2 = _shrunk(tmp_path)
+    assert arb2.epoch_boundary(now=0.0) == "grow"
+    assert arb2.decisions[-1]["trigger"] == "epoch_boundary"
+
+
+def test_lease_ttl_reaps_dead_holders(tmp_path):
+    arb = _shrunk(tmp_path, lease_ttl_s=10.0)
+    assert arb.request_lease("replica:1", now=100.0)
+    arb.tick(now=105.0)   # inside the TTL: lease survives
+    assert arb.has_lease("replica:1")
+    arb.tick(now=111.0)   # past granted_at + 10s: reaped
+    assert not arb.leases
+    assert any(
+        d["action"] == "lease_expired" and d["owner"] == "replica:1"
+        for d in arb.decisions
+    )
+
+
+# ---------------------------------------------------------------------------
+# Hardened capacity-file probe: invalid reads as "no change"
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def obs_points(monkeypatch):
+    rec = []
+    monkeypatch.setattr(
+        obs, "point", lambda name, **labels: rec.append((name, labels))
+    )
+    return rec
+
+
+def _invalid_reasons(points):
+    return [
+        lb["reason"] for name, lb in points
+        if name == "capacity_file_invalid"
+    ]
+
+
+def test_probe_invalid_files_hold_current_world(tmp_path, obs_points):
+    """Torn/empty/malformed capacity files must never resize a running
+    world: with ``current`` the probe holds it, and each rejection is a
+    ``capacity_file_invalid`` point naming the reason."""
+    cap = str(tmp_path / "capacity.json")
+    for payload in ('{"available": 4', "", "[1, 2]", '"4"'):
+        (tmp_path / "capacity.json").write_text(payload)
+        assert faults.probe_capacity(cap, 8, current=4) == 4
+        assert faults.probe_capacity(cap, 8) == 8  # no current: full
+    assert _invalid_reasons(obs_points) == ["malformed"] * 8
+    # a dict with a non-numeric available is the same verdict
+    (tmp_path / "capacity.json").write_text('{"available": "soon"}')
+    assert faults.probe_capacity(cap, 8, current=2) == 2
+    assert _invalid_reasons(obs_points)[-1] == "malformed"
+    # a MISSING file stays "full capacity" even with current= — absence
+    # is the documented steady state, not corruption
+    os.unlink(cap)
+    assert faults.probe_capacity(cap, 8, current=4) == 8
+    # unreadable (a directory): held, reason=unreadable
+    os.mkdir(cap)
+    assert faults.probe_capacity(cap, 8, current=4) == 4
+    assert _invalid_reasons(obs_points)[-1] == "unreadable"
+
+
+def test_probe_stale_file_holds_current_world(
+    tmp_path, monkeypatch, obs_points
+):
+    cap = str(tmp_path / "capacity.json")
+    faults.write_capacity(cap, 4, owner="fault")
+    monkeypatch.setenv(faults.CAPACITY_STALE_ENV, "60")
+    assert faults.probe_capacity(cap, 8, current=2) == 4  # fresh
+    old = time.time() - 120.0
+    os.utime(cap, (old, old))
+    assert faults.probe_capacity(cap, 8, current=2) == 2  # stale: hold
+    assert faults.probe_capacity(cap, 8) == 8             # no current
+    assert _invalid_reasons(obs_points) == ["stale", "stale"]
+    monkeypatch.setenv(faults.CAPACITY_STALE_ENV, "0")    # 0 = disabled
+    assert faults.probe_capacity(cap, 8, current=2) == 4
+
+
+def test_probe_unknown_owner_holds_current_world(tmp_path, obs_points):
+    cap = str(tmp_path / "capacity.json")
+    for owner in faults.CAPACITY_OWNERS:
+        faults.write_capacity(cap, 4, owner=owner)
+        assert faults.probe_capacity(cap, 8, current=8) == 4
+    faults.write_capacity(cap, 4)  # legacy no-owner files stay valid
+    assert faults.probe_capacity(cap, 8, current=8) == 4
+    assert _invalid_reasons(obs_points) == []
+    faults.write_capacity(cap, 4, owner="gremlin")
+    assert faults.probe_capacity(cap, 8, current=8) == 8
+    assert faults.probe_capacity(cap, 8) == 8
+    assert _invalid_reasons(obs_points) == [
+        "unknown_owner", "unknown_owner",
+    ]
+
+
+def test_arbiter_capacity_roundtrip_with_probe_current(tmp_path):
+    """The arbiter's writes drive launch.py's probe exactly: shrink
+    reads back as the reduced world, grow as the full one, and an
+    intervening torn write changes nothing."""
+    arb = _shrunk(tmp_path)
+    cap = str(tmp_path / "capacity.json")
+    assert faults.probe_capacity(cap, 8, current=8) == 4
+    with open(cap, "w") as fh:
+        fh.write('{"available"')   # torn overwrite mid-flight
+    assert faults.probe_capacity(cap, 8, current=4) == 4
+    arb._grow(trigger="test")
+    assert faults.probe_capacity(cap, 8, current=4) == 8
+    assert json.loads(open(cap).read())["owner"] == "arbiter"
+
+
+# ---------------------------------------------------------------------------
+# Brownout ladder exhaustion (the arbiter's escalation signal)
+# ---------------------------------------------------------------------------
+
+def test_brownout_ladder_exhaustion_signal():
+    from distributeddeeplearning_tpu.serving.scheduler import (
+        BrownoutLadder,
+        parse_brownout_stages,
+    )
+
+    burn = {"on": True}
+
+    def reader():
+        return {"slo": [
+            {"objective": "ttft", "stat": "p99", "metric": "serve.ttft",
+             "burning": burn["on"]}
+        ] if burn["on"] else []}
+
+    class _Router:
+        def apply_brownout_stage(self, stage, on, key=None):
+            pass
+
+    ladder = BrownoutLadder(
+        parse_brownout_stages("spec_off,max_new:8"), reader=reader,
+        refresh_s=0.0, escalate_ticks=1, recover_ticks=1,
+    )
+    router = _Router()
+    assert not ladder.exhausted
+    assert ladder.tick(router, 0.0) == "down"
+    assert not ladder.exhausted           # stage 2 still unapplied
+    assert ladder.tick(router, 0.0) == "down"
+    assert ladder.exhausted               # all stages on, still burning
+    burn["on"] = False
+    ladder.tick(router, 0.0)
+    assert not ladder.exhausted           # recovered: burn is out
+
+
+# ---------------------------------------------------------------------------
+# faultgen coloc-drill + combined-plan validate
+# ---------------------------------------------------------------------------
+
+def _faultgen(*args):
+    return subprocess.run(
+        [sys.executable, "scripts/faultgen.py", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_faultgen_coloc_drill_emits_paired_plans(tmp_path):
+    res = _faultgen(
+        "coloc-drill", "--shrink-step", "6", "--ranks", "1",
+        "--restore-step", "10", "--replicas", "2", "--storm-seed", "3",
+    )
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.strip().splitlines()
+    assert lines[0] == (
+        "FAULT_PLAN=shrink:step=6,ranks=1;restore_capacity:step=10"
+    )
+    assert lines[1].startswith("SERVE_CHAOS_PLAN=")
+    # both emitted dialects re-validate, separately and combined
+    for line in lines:
+        v = _faultgen("validate", line.split("=", 1)[1])
+        assert v.returncode == 0, v.stderr
+    combined = tmp_path / "coloc.plan"
+    combined.write_text(res.stdout)
+    v = _faultgen("validate", str(combined))
+    assert v.returncode == 0, v.stderr
+    assert "combined plan (both dialects):" in v.stdout
+    assert "shrink" in v.stdout and "crash" in v.stdout
+
+
+def test_faultgen_validate_rejects_bad_combined_plan(tmp_path):
+    bad = tmp_path / "bad.plan"
+    bad.write_text(
+        "FAULT_PLAN=shrink:step=6,ranks=0\n"
+        "SERVE_CHAOS_PLAN=crash:tick=5,replica=0\n"
+    )
+    assert _faultgen("validate", str(bad)).returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# bench_trend: a re-arbitrated pool is a protocol skip
+# ---------------------------------------------------------------------------
+
+def test_bench_trend_coloc_change_is_skip_not_regression(tmp_path):
+    from scripts.bench_trend import analyze
+
+    def rec(n, value, coloc=None):
+        detail = {"platform": "cpu"}
+        if coloc is not None:
+            detail["coloc"] = coloc
+        path = tmp_path / f"BENCH_r{n:02d}.json"
+        path.write_text(json.dumps({
+            "n": n, "rc": 0,
+            "parsed": {"metric": "lm_coloc_tokens_per_sec",
+                       "value": value, "unit": "tokens/sec",
+                       "detail": detail},
+        }))
+        return str(path)
+
+    knobs = "pool=8;shrink_step=6;stages=spec_off,max_new:8;surge=8:60"
+    paths = [
+        rec(1, 100.0, coloc=knobs),
+        rec(2, 40.0, coloc=knobs.replace("pool=8", "pool=4")),  # re-shaped
+        rec(3, 39.0, coloc=knobs.replace("pool=8", "pool=4")),  # fine
+        rec(4, 10.0, coloc=knobs.replace("pool=8", "pool=4")),  # REAL drop
+    ]
+    out = analyze(paths, threshold=0.10)
+    rows = {r["round"]: r for r in out["rows"]}
+    assert rows[2]["skip"].startswith("coloc_change:")
+    assert rows[3]["skip"] is None and rows[3]["delta_pct"] is not None
+    assert len(out["regressions"]) == 1
+    assert out["regressions"][0]["to_round"] == 4
+    # non-colocated records normalize together and stay comparable
+    out2 = analyze([rec(5, 100.0), rec(6, 99.0)], threshold=0.10)
+    assert out2["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Heavy: the combined fault+chaos storm drill (make coloc-bench)
+# ---------------------------------------------------------------------------
+
+def test_coloc_bench_combined_storm_drill(tmp_path):
+    """Run the real drill end to end on the CPU tier: every gate in the
+    emitted record must hold (registered in tests/heavy_tests.txt)."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "OBS_DIR": str(tmp_path / "run"),
+    }
+    env.pop("XLA_FLAGS", None)  # the bench forces its own device count
+    res = subprocess.run(
+        [sys.executable, "scripts/coloc_bench.py"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=840,
+        env=env,
+    )
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    rec = json.loads(res.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "lm_coloc_tokens_per_sec"
+    assert rec["value"] > 0
+    gates = rec["detail"]["gates"]
+    assert all(v is not False for v in gates.values()), gates
+    actions = [
+        d["action"] for d in rec["detail"]["storm"]["arbiter_decisions"]
+    ]
+    assert "shrink" in actions and "grow" in actions
+    assert actions.index("shrink") < actions.index("grow")
+    # the pool-ownership timeline renders from the captured events
+    rep = subprocess.run(
+        [sys.executable, "scripts/obs_report.py", str(tmp_path / "run")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        env=env,
+    )
+    assert rep.returncode == 0, rep.stderr
+    assert "pool ownership" in rep.stdout
+    assert "arbiter.shrink" in rep.stdout

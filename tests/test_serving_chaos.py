@@ -407,7 +407,17 @@ def test_controller_holds_scale_up_after_breaker_opens(model, params):
     )
     assert ctl.tick() is None     # hot, but held by the open breaker
     assert built == []
-    ctl.config.breaker_block_ticks = 0  # disable the hold: scale-up flows
+    # The hold is one fleet.scaleup_denied + a tick-counted backoff —
+    # the controller does not re-ask (or re-emit) every tick.
+    assert [a for a in ctl.actions if a["action"] == "scaleup_denied"] == [
+        {"action": "scaleup_denied", "reason": "breaker",
+         "pressure": 5.0, "breaker_tick": router.last_breaker_tick},
+    ]
+    ctl.config.breaker_block_ticks = 0  # disable the hold
+    assert ctl.tick() is None     # still backing off from the denial
+    assert len(ctl.actions) == 1  # ...silently: no denial spam
+    for _ in range(ctl.config.denied_backoff_ticks):
+        router.step()             # walk the router clock past the backoff
     assert ctl.tick() == "scale_up"
     assert built == [2]
     router.close()
