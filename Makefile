@@ -27,6 +27,7 @@ PY                ?= python
         obs-watch trace-report bench-trend accum-memory fault-suite \
         elastic-drill \
         serve-bench serve-bench-spec fleet-bench chaos-bench coloc-bench \
+        disagg-bench \
         stream-shards \
         stream-bench native \
         provision setup submit stream status stop teardown
@@ -130,6 +131,15 @@ chaos-bench:	## seeded mixed-verb fault storm over a closed 3-tenant
 	## multiple (docs/ROBUSTNESS.md serving failure model;
 	## serve_lm_chaos recertify row; SERVE_CHAOS_PLAN/SERVE_CHAOS_SEED)
 	$(PY) scripts/chaos_bench.py
+
+disagg-bench:	## disaggregated prefill/decode pools vs the colocated
+	## fleet at equal replica count on a bimodal storm with a hot
+	## shared system prefix — gates strictly-better p99 TTFT, bounded
+	## inter-token p99, bitwise parity vs sequential generate,
+	## prefill-once-per-fleet via the prefix directory, one scheduled
+	## zero-drop live migration, and closed program sets per pool
+	## (docs/SERVING.md disaggregation; serve_lm_disagg recertify row)
+	$(PY) scripts/disagg_bench.py
 
 coloc-bench:	## combined fault+chaos storm over ONE device pool: a
 	## serving surge drives the brownout ladder to exhaustion, the

@@ -343,6 +343,16 @@ def summarize(loaded: Dict[str, Any]) -> Dict[str, Any]:
             "brownout_steps": points.get("serve.brownout_step", 0),
             "brownout_shed": counters.get("serve.brownout_shed", 0),
             "brownout_stage": gauges.get("fleet.brownout_stage"),
+            # Disaggregated serving (docs/SERVING.md): the final pool
+            # split, prefill->decode handoff seam stats, fleet prefix-
+            # directory hits and scheduled live migrations. All 0/None
+            # on a colocated fleet, which emits none of them.
+            "prefill_replicas": gauges.get("fleet.prefill_replicas"),
+            "decode_replicas": gauges.get("fleet.decode_replicas"),
+            "handoffs": span_stats.get("fleet.handoff"),
+            "handoff_ms": gauges.get("serve.handoff_ms"),
+            "directory_hits": counters.get("serve.directory_hits", 0),
+            "migrations": counters.get("serve.migrations", 0),
         }
 
     # Trace plane (obs/traces.py): per-request critical paths with gap
@@ -548,6 +558,27 @@ def render(summary: Dict[str, Any], top_n: int = 20) -> str:
             )
         if heals:
             add("  fleet health: " + ", ".join(heals))
+        # Disaggregation line: the pool split and what flowed over the
+        # prefill->decode seam (colocated fleets emit none of this).
+        if (
+            srv.get("prefill_replicas") is not None
+            or srv.get("directory_hits") or srv.get("migrations")
+        ):
+            ho = srv.get("handoffs")
+            add(
+                f"  disaggregated: "
+                f"{(srv.get('prefill_replicas') or 0):.0f} prefill + "
+                f"{(srv.get('decode_replicas') or 0):.0f} decode replicas"
+                + (
+                    f", {ho['count']} handoff(s) "
+                    f"(seam p50 {ho['p50_ms']:.2f}ms)" if ho else ""
+                )
+                + f", directory hits {srv['directory_hits']:.0f}"
+                + (
+                    f", {srv['migrations']:.0f} live migration(s)"
+                    if srv.get("migrations") else ""
+                )
+            )
         # Per-request latency anatomy: where the time went.
         for label, key in (
             ("queue wait", "queue_wait"), ("ttft", "ttft"),

@@ -49,6 +49,11 @@ PHASE_SPANS = {
     "serve.decode_share": "decode",
     "serve.delivery": "delivery",
     "fleet.reroute": "reroute",
+    # Disaggregation (docs/SERVING.md): the prefill→decode handoff
+    # window and a scheduled live migration are attributed wall, same
+    # bucket as a re-route — time the stream spent between engines.
+    "fleet.handoff": "reroute",
+    "fleet.migration": "reroute",
 }
 PHASES = ("router_wait", "queue_wait", "prefill", "decode", "delivery",
           "reroute")
@@ -63,7 +68,7 @@ _ADMISSION_NAMES = {
 #: Chaos-plane / lifecycle interventions surfaced as causal annotations.
 _INTERVENTION_NAMES = {
     "fleet.reroute", "fleet.splice_mismatch", "fleet.restart_divergence",
-    "serve.brownout_shed",
+    "serve.brownout_shed", "fleet.handoff", "fleet.migration",
 }
 
 

@@ -49,6 +49,7 @@ co-scheduling — ``tests/test_serving.py`` is the oracle
 from distributeddeeplearning_tpu.serving.blocks import (  # noqa: F401
     BlockAllocator,
     BlockPoolExhausted,
+    PrefixDirectory,
 )
 from distributeddeeplearning_tpu.serving.chaos import (  # noqa: F401
     ChaosCrash,

@@ -101,6 +101,10 @@ class BlockAllocator:
         self._lru: "collections.OrderedDict[int, None]" = (
             collections.OrderedDict()
         )
+        # Blocks the fleet-wide prefix directory maps on this replica:
+        # never evicted, never recycled to the free list while pinned
+        # (docs/SERVING.md disaggregation section).
+        self._pinned: set = set()
         self.stats = {
             "allocated": 0, "freed": 0, "evicted": 0, "cow": 0,
             "prefix_hit_blocks": 0, "prefix_hit_requests": 0,
@@ -117,8 +121,9 @@ class BlockAllocator:
     @property
     def free_count(self) -> int:
         """Blocks an ``alloc`` could hand out right now (free +
-        evictable cached)."""
-        return len(self._free) + len(self._lru)
+        evictable cached; pinned cache entries are not evictable)."""
+        pinned_cached = sum(1 for b in self._lru if b in self._pinned)
+        return len(self._free) + len(self._lru) - pinned_cached
 
     @property
     def live_count(self) -> int:
@@ -134,7 +139,12 @@ class BlockAllocator:
     # -- alloc / free ------------------------------------------------------
 
     def _evict_one(self) -> int:
-        bid, _ = self._lru.popitem(last=False)
+        bid = next(
+            (b for b in self._lru if b not in self._pinned), None
+        )
+        if bid is None:  # alloc's free_count guard makes this unreachable
+            raise BlockPoolExhausted("every cached block is pinned")
+        del self._lru[bid]
         h = self._hash_of.pop(bid, None)
         if h is not None:
             self._by_hash.pop(h, None)
@@ -175,7 +185,11 @@ class BlockAllocator:
             self._ref[bid] = left
             return
         del self._ref[bid]
-        if bid in self._hash_of:
+        if bid in self._hash_of or bid in self._pinned:
+            # Registered content stays discoverable; a pinned partial
+            # block (directory tail payload source) stays resident even
+            # though it has no chain hash — both sit in the LRU, and
+            # eviction skips pinned entries.
             self._lru[bid] = None
             self._lru.move_to_end(bid)
         else:
@@ -184,6 +198,31 @@ class BlockAllocator:
 
     def refcount(self, bid: int) -> int:
         return self._ref.get(bid, 0)
+
+    # -- directory pins ----------------------------------------------------
+
+    def pin(self, bid: int) -> None:
+        """Exempt ``bid`` from eviction and free-list recycling: the
+        fleet-wide prefix directory maps this block, possibly from
+        another replica. Pin while the block is resident (referenced or
+        cached); the pin survives the refcount reaching zero."""
+        if bid == TRASH_BLOCK:
+            raise ValueError("cannot pin the trash sink")
+        if bid not in self._ref and bid not in self._lru:
+            raise KeyError(f"block {bid} is not resident")
+        self._pinned.add(bid)
+
+    def unpin(self, bid: int) -> None:
+        """Release a directory pin. An unpinned zero-ref block becomes
+        evictable again (registered) or returns to the free list
+        (unregistered partial block)."""
+        self._pinned.discard(bid)
+        if bid in self._lru and bid not in self._hash_of:
+            del self._lru[bid]
+            self._free.append(bid)
+
+    def pinned(self, bid: int) -> bool:
+        return bid in self._pinned
 
     def ensure_private(self, bid: int) -> int:
         """Copy-on-write entry point: return a block id the caller may
@@ -275,5 +314,203 @@ class BlockAllocator:
             "free": self.free_count,
             "live": self.live_count,
             "cached": len(self._lru),
+            "pinned": len(self._pinned),
+            **self.stats,
+        }
+
+
+def prompt_key(tokens: np.ndarray) -> bytes:
+    """Directory key for a *whole* prompt (full and partial blocks):
+    one hash over every token, position-dependent by construction."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    return hashlib.sha1(toks.tobytes()).digest()
+
+
+class PrefixDirectory:
+    """Fleet-wide prefix directory: which replica holds which prefilled
+    KV blocks, keyed by the same position-dependent content-hash chains
+    the per-replica prefix cache uses (:func:`hash_prefix_chain`).
+
+    Pure host-side metadata plus host-staged block payloads — the
+    directory never touches a device or an allocator. The Router is the
+    only writer: it publishes after a prefill replica exports a slot
+    (the exporter pinned the blocks first, so every ``(rid, bid)`` the
+    directory maps stays resident on that replica), serves **adoptions**
+    (a second consumer of an identical greedy prompt seats decode state
+    straight from the entry — zero prefill-program executions), serves
+    **chain prefetches** (a different prompt sharing a full-block prefix
+    imports just those blocks into its target replica's local cache),
+    and re-homes or drops entries when a holder replica dies.
+
+    Entries are published only for greedy (``temperature == 0.0``)
+    requests: the entry carries the deterministic first token, which is
+    what makes adoption a pure state transplant. Payloads are staged on
+    host at export time (CPU tier; a device-to-device block DMA is the
+    TPU path) so no cross-thread device read ever races a replica's
+    pump donating its pool.
+
+    Refcount surface (``tests/test_serving_disagg.py`` ledger oracle):
+    ``holders`` maps ``rid -> [bid, ...]`` per entry — every mapped
+    block is pinned on that replica; ``drop_replica`` re-homes the
+    owner to a surviving holder or drops the entry, and ``clear``
+    returns every pin so allocator ledgers balance at teardown.
+    """
+
+    def __init__(self) -> None:
+        # prompt_key -> entry dict (see publish()).
+        self._entries: Dict[bytes, Dict] = {}
+        # chain hash -> (prompt_key, block index) for full-block
+        # prefix lookups across entries.
+        self._chains: Dict[bytes, Tuple[bytes, int]] = {}
+        self.stats = {
+            "publishes": 0, "lookups": 0, "hits": 0,
+            "chain_hits": 0, "rehomed": 0, "dropped": 0,
+        }
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # -- publish -----------------------------------------------------------
+
+    def publish(
+        self,
+        rid: int,
+        prompt: np.ndarray,
+        block_ids: Sequence[int],
+        payload: Dict,
+        *,
+        first_token: int,
+        block_size: int,
+    ) -> bool:
+        """Record that replica ``rid`` holds the prefilled blocks of
+        ``prompt`` (``block_ids`` in logical order, covering every
+        written position — the tail entry may be a partial block).
+        ``payload`` is the host-staged block content (leaf-path ->
+        ``[len(block_ids), block_size, ...]`` numpy). First writer
+        wins; a later publish of the same prompt adds ``rid`` as
+        another holder. Returns True when ``rid`` became a holder
+        (caller keeps its pins), False when the publish was a no-op
+        (caller should unpin)."""
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        key = prompt_key(toks)
+        ent = self._entries.get(key)
+        if ent is not None:
+            if rid in ent["holders"]:
+                return False
+            ent["holders"][rid] = [int(b) for b in block_ids]
+            self.stats["publishes"] += 1
+            return True
+        ent = {
+            "prompt": toks.copy(),
+            "owner": int(rid),
+            "holders": {int(rid): [int(b) for b in block_ids]},
+            "payload": payload,
+            "first_token": int(first_token),
+            "block_size": int(block_size),
+            "adoptions": 0,
+        }
+        self._entries[key] = ent
+        for k, h in enumerate(hash_prefix_chain(toks, block_size)):
+            if k >= len(block_ids):
+                break
+            self._chains.setdefault(h, (key, k))
+        self.stats["publishes"] += 1
+        return True
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup(self, prompt: np.ndarray) -> Optional[Dict]:
+        """Exact whole-prompt entry (adoption candidate) or None."""
+        self.stats["lookups"] += 1
+        ent = self._entries.get(prompt_key(prompt))
+        if ent is not None:
+            self.stats["hits"] += 1
+        return ent
+
+    def adopt(self, prompt: np.ndarray) -> Optional[Dict]:
+        """:meth:`lookup` that also counts an adoption on the entry."""
+        ent = self.lookup(prompt)
+        if ent is not None:
+            ent["adoptions"] += 1
+        return ent
+
+    def lookup_chain(
+        self, prompt: np.ndarray, block_size: int
+    ) -> Tuple[int, Optional[Dict], Dict]:
+        """Longest directory-held chain of leading FULL blocks of
+        ``prompt``. Returns ``(n_blocks, entry, payload_slice)`` where
+        ``payload_slice`` maps leaf path -> the first ``n_blocks`` rows
+        of the holding entry's payload (host numpy). ``(0, None, {})``
+        on a miss or block-size mismatch."""
+        chain = hash_prefix_chain(prompt, block_size)
+        n = 0
+        ref: Optional[Tuple[bytes, int]] = None
+        for k, h in enumerate(chain):
+            hit = self._chains.get(h)
+            if hit is None:
+                break
+            ref = hit
+            n += 1
+        if n == 0 or ref is None:
+            return 0, None, {}
+        ent = self._entries.get(ref[0])
+        if ent is None or ent["block_size"] != block_size:
+            return 0, None, {}
+        self.stats["chain_hits"] += 1
+        sliced = {p: a[:n] for p, a in ent["payload"].items()}
+        return n, ent, sliced
+
+    # -- membership --------------------------------------------------------
+
+    def drop_replica(self, rid: int) -> List[Tuple[int, List[int]]]:
+        """Forget every block ``rid`` held (replica failed/removed).
+        Entries re-home to a surviving holder; an entry with no holder
+        left is dropped (its chain hashes too). Returns the
+        ``(rid, block_ids)`` pairs that were unmapped so a caller with
+        a live replica (drain path) can unpin them."""
+        unmapped: List[Tuple[int, List[int]]] = []
+        dead: List[bytes] = []
+        for key, ent in self._entries.items():
+            bids = ent["holders"].pop(rid, None)
+            if bids is None:
+                continue
+            unmapped.append((rid, bids))
+            if not ent["holders"]:
+                dead.append(key)
+            elif ent["owner"] == rid:
+                ent["owner"] = next(iter(ent["holders"]))
+                self.stats["rehomed"] += 1
+        for key in dead:
+            ent = self._entries.pop(key)
+            self._chains = {
+                h: ref for h, ref in self._chains.items() if ref[0] != key
+            }
+            self.stats["dropped"] += 1
+        return unmapped
+
+    def mapped_blocks(self, rid: int) -> List[int]:
+        """Every block id the directory maps on ``rid`` (test oracle:
+        each must be pinned + resident there)."""
+        out: List[int] = []
+        for ent in self._entries.values():
+            out.extend(ent["holders"].get(rid, []))
+        return out
+
+    def clear(self) -> List[Tuple[int, List[int]]]:
+        """Drop every entry, returning all ``(rid, block_ids)``
+        mappings so the caller can unpin them (teardown ledger
+        balance)."""
+        out: List[Tuple[int, List[int]]] = []
+        for ent in self._entries.values():
+            for rid, bids in ent["holders"].items():
+                out.append((int(rid), list(bids)))
+        self._entries.clear()
+        self._chains.clear()
+        return out
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "entries": len(self._entries),
+            "chains": len(self._chains),
             **self.stats,
         }

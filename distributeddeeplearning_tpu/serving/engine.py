@@ -179,6 +179,7 @@ class SlotEngine:
         spec_k: int = 0,
         spec_draft: str = "int8",
         spec_ngram_n: int = 3,
+        pool_role: str = "both",
     ) -> None:
         from distributeddeeplearning_tpu.ops import quant as quantlib
 
@@ -188,6 +189,29 @@ class SlotEngine:
             raise ValueError(
                 f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
             )
+        # Disaggregated serving (docs/SERVING.md): a pool-typed engine
+        # compiles only its phase's programs — "prefill" skips the
+        # decode step, "decode" skips the prefill ladder — so each pool
+        # keeps a smaller closed program set. Pool typing requires the
+        # paged layout (the block table is the handoff unit) and no
+        # speculation (the draft pool's state does not travel).
+        if pool_role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"pool_role must be one of ('both', 'prefill', 'decode'), "
+                f"got {pool_role!r}"
+            )
+        if pool_role != "both":
+            if kv_layout != "paged":
+                raise ValueError(
+                    f"pool_role={pool_role!r} requires kv_layout='paged' "
+                    "(the block table is the handoff unit)"
+                )
+            if spec_k:
+                raise ValueError(
+                    f"pool_role={pool_role!r} is incompatible with "
+                    f"spec_k={spec_k} (draft state does not travel)"
+                )
+        self.pool_role = pool_role
         # "bf16" means *native* (store the model's compute dtype — the
         # pre-quantization behaviour); "int8"/"fp8" engage ops/quant.py.
         # The supported tiers live in ONE registry (quant.KV_DTYPES /
@@ -395,6 +419,11 @@ class SlotEngine:
         self.compile_count = 0
         self.compile_sec = 0.0
         self.decode_steps = 0
+        # Prefill-program executions (the disagg bench's
+        # prefill-once-per-fleet oracle: a directory adoption must add
+        # exactly zero here across the whole fleet).
+        self.prefill_execs = 0
+        self._warmed = False
         # Brownout ladder hook (serving/scheduler.py): True routes
         # ticks through the plain decode program (already compiled —
         # the program set is unchanged); draft state keeps tracking the
@@ -705,7 +734,13 @@ class SlotEngine:
         per bucket, plus — speculative tier — the batched verify and,
         for the int8 self-draft, the draft phase + one draft prefill
         per bucket. Enlarged but CLOSED: ``compile_count`` equals this
-        for the engine's whole lifetime after :meth:`warmup`."""
+        for the engine's whole lifetime after :meth:`warmup`. A
+        pool-typed engine (disaggregated serving) owns only its phase's
+        programs: ``prefill`` → one per bucket, ``decode`` → one."""
+        if self.pool_role == "prefill":
+            return len(self.buckets)
+        if self.pool_role == "decode":
+            return 1
         n = len(self.buckets) + 1
         if self.spec_enabled:
             n += 1  # the [S, spec_k+1] verify
@@ -787,13 +822,16 @@ class SlotEngine:
                 np.zeros(s, np.int32), np.zeros(s, np.float32),
                 np.full(s, -1, np.int32),
             )
-        specs.append(ProgramSpec(
-            "decode",
-            self._decode_paged_fn if paged else self._decode_fn,
-            (1,), decode_args,
-            {"what": "serve_decode", "slots": s},
-            *slot_attr("_decode_exec"),
-        ))
+        if self.pool_role != "prefill":
+            specs.append(ProgramSpec(
+                "decode",
+                self._decode_paged_fn if paged else self._decode_fn,
+                (1,), decode_args,
+                {"what": "serve_decode", "slots": s},
+                *slot_attr("_decode_exec"),
+            ))
+        if self.pool_role == "decode":
+            return specs
         for bucket in self.buckets:
             if paged:
                 prefill_args = (
@@ -886,6 +924,7 @@ class SlotEngine:
                 )
                 self.compile_sec += time.perf_counter() - t0
             self.compile_count += 1
+        self._warmed = True
         if self.kv_layout == "paged":
             self._emit_pool_gauges()
         acct = self.byte_accounting()
@@ -997,8 +1036,24 @@ class SlotEngine:
             self.allocator.peek_prefix(prompt, t - 1)
             if self.prefix_cache else 0
         )
+        hit = self._prefix_fit(t, hit)
         need = self.blocks_needed(t, spec.max_new_tokens) - hit
         return self.allocator.free_count >= max(need, 0)
+
+    def _prefix_fit(self, t: int, n_blocks: int) -> int:
+        """Largest usable cached-prefix block count for a ``t``-token
+        prompt. A prefix hit shifts the suffix program's bucket window
+        to ``[start, start + bucket)``; rows past ``max_len`` have no
+        position embedding — the padded tail gathers NaN fill, the NaN
+        K/V lands in the trash block, and the zero-masked-weight ×
+        NaN value product poisons every slot's attention output.
+        Recomputing a few cached positions is correct; a NaN is never
+        recoverable."""
+        start = n_blocks * self.block_size
+        while n_blocks and start + self.bucket_for(t - start) > self.max_len:
+            n_blocks -= 1
+            start -= self.block_size
+        return n_blocks
 
     @property
     def free_slots(self) -> List[int]:
@@ -1071,8 +1126,13 @@ class SlotEngine:
         caller decides to :meth:`release`."""
         if self._active[slot]:
             raise ValueError(f"slot {slot} is occupied")
+        if self.pool_role == "decode":
+            raise RuntimeError(
+                "a decode-pool engine has no prefill programs; requests "
+                "reach it only through import_slot (handoff/migration)"
+            )
         tk = self.validate_spec(spec)
-        if self._decode_exec is None:
+        if not self._warmed:
             self.warmup()
         prompt = np.asarray(spec.prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
@@ -1107,6 +1167,7 @@ class SlotEngine:
                 np.int32(t), np.asarray(key0, np.uint32), temp, top_k,
                 top_p, eos,
             )
+            self.prefill_execs += 1
             self.last_prefill = {
                 "slot": slot, "bucket": bucket, "start": 0,
                 "shared_blocks": 0,
@@ -1149,6 +1210,10 @@ class SlotEngine:
         shared: List[int] = (
             a.match_prefix(prompt, t - 1) if self.prefix_cache else []
         )
+        keep = self._prefix_fit(t, len(shared))
+        if keep < len(shared):
+            a.release_match(shared[keep:])
+            shared = shared[:keep]
         start = len(shared) * self.block_size
         suffix = prompt[start:]
         suffix_len = t - start
@@ -1170,6 +1235,7 @@ class SlotEngine:
             np.int32(suffix_len - 1), np.asarray(key0, np.uint32), temp,
             top_k, top_p, eos,
         )
+        self.prefill_execs += 1
         if self.prefix_cache:
             # The full prompt blocks this request owns are now written
             # and immutable (decode writes start at prompt_len) — make
@@ -1363,3 +1429,163 @@ class SlotEngine:
             self._slot_blocks[slot] = []
             self._tables[slot] = 0
             self._emit_pool_gauges()
+
+    # -- slot state transfer (disaggregation / migration) ------------------
+
+    def export_blocks(self, block_ids) -> Dict[Tuple[str, ...], np.ndarray]:
+        """Host-stage the KV content of ``block_ids``: leaf path ->
+        ``[len(block_ids), block_size, ...]`` numpy rows gathered from
+        every paged pool leaf. Pure read — no program runs, the pool is
+        untouched. The caller must hold the blocks resident (referenced
+        or pinned) for the read to be meaningful."""
+        if self.allocator is None:
+            raise RuntimeError("export_blocks requires kv_layout='paged'")
+        idx = np.asarray(list(block_ids), np.int64)
+        flat = self._flatten(self._unfreeze(self._pool))
+        out: Dict[Tuple[str, ...], np.ndarray] = {}
+        for path, leaf in flat.items():
+            if path[-1] in _PAGED_POOL_NAMES:
+                out[path] = np.asarray(leaf)[idx].copy()
+        return out
+
+    def _import_block_payload(self, block_ids, payload) -> None:
+        """Write host-staged block content into ``block_ids`` of the
+        local pool. Host copy + ``jax.device_put`` — no program runs,
+        nothing compiles, so the closed program set is untouched (the
+        CPU tier's stand-in for a device-to-device block DMA)."""
+        idx = np.asarray(list(block_ids), np.int64)
+        flat = self._flatten(self._unfreeze(self._pool))
+        out = {}
+        for path, leaf in flat.items():
+            if path[-1] in _PAGED_POOL_NAMES and path in payload:
+                host = np.array(leaf)
+                host[idx] = payload[path]
+                out[path] = jax.device_put(host)
+            else:
+                out[path] = leaf
+        self._pool = self._unflatten(out)
+
+    def export_slot(self, slot: int) -> Dict[str, Any]:
+        """Snapshot everything slot ``slot`` needs to continue decoding
+        bitwise-identically on ANOTHER engine: the sampling state, the
+        key-ladder cursor, and the host-staged content of every written
+        KV block. The slot itself is untouched — the caller releases it
+        (handoff) or keeps it (directory publish reads). The importing
+        engine replays nothing: decode resumes at the exact cursor with
+        the exact ladder row, so the continuation is the same stream the
+        exporting engine would have produced."""
+        if self.allocator is None:
+            raise RuntimeError("export_slot requires kv_layout='paged'")
+        if self.spec_enabled:
+            raise RuntimeError(
+                "export_slot is incompatible with spec_k > 0 (the draft "
+                "pool's lookahead state does not travel)"
+            )
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not occupied")
+        written = int(self._positions[slot])
+        blocks = list(self._slot_blocks[slot])
+        nwritten = self.allocator.blocks_for_tokens(written)
+        ladder = self._ladders[slot]
+        return {
+            "block_size": self.block_size,
+            "n_blocks": len(blocks),
+            "blocks": blocks,
+            "written": written,
+            "token": int(self._tokens[slot]),
+            "temp": float(self._temps[slot]),
+            "top_k": int(self._top_ks[slot]),
+            "top_p": float(self._top_ps[slot]),
+            "eos": int(self._eos[slot]),
+            "ladder": None if ladder is None else np.array(ladder),
+            "cursor": int(self._cursor[slot]),
+            "payload": self.export_blocks(blocks[:nwritten]),
+        }
+
+    def can_import(self, state: Dict[str, Any]) -> bool:
+        """Room for an imported slot right now? (a free slot AND the
+        state's block count allocatable)."""
+        if self.allocator is None:
+            return False
+        return (
+            bool(self.free_slots)
+            and self.allocator.free_count >= int(state["n_blocks"])
+        )
+
+    def import_slot(
+        self, slot: int, state: Dict[str, Any],
+        prompt: Optional[np.ndarray] = None,
+    ) -> None:
+        """Seat an exported slot state (:meth:`export_slot`, or a
+        directory adoption's synthetic state): allocate fresh blocks,
+        write the staged KV content, and restore the sampling state so
+        the next :meth:`decode_step` continues the stream bitwise.
+        ``prompt`` (when given, with the prefix cache on) registers the
+        full prompt blocks locally so later requests prefix-hit here."""
+        if self.allocator is None:
+            raise RuntimeError("import_slot requires kv_layout='paged'")
+        if self.spec_enabled:
+            raise RuntimeError("import_slot is incompatible with spec_k > 0")
+        if self._active[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        if int(state["block_size"]) != self.block_size:
+            raise ValueError(
+                f"block_size mismatch: exported {state['block_size']}, "
+                f"local {self.block_size}"
+            )
+        if not self._warmed:
+            self.warmup()
+        n = int(state["n_blocks"])
+        blocks = self.allocator.alloc(n)  # BlockPoolExhausted -> caller
+        nwritten = self.allocator.blocks_for_tokens(int(state["written"]))
+        self._import_block_payload(blocks[:nwritten], state["payload"])
+        self._tables[slot] = 0
+        self._tables[slot, :n] = blocks
+        self._slot_blocks[slot] = blocks
+        self._active[slot] = True
+        self._tokens[slot] = np.int32(state["token"])
+        self._positions[slot] = np.int32(state["written"])
+        self._temps[slot] = np.float32(state["temp"])
+        self._top_ks[slot] = np.int32(state["top_k"])
+        self._top_ps[slot] = np.float32(state["top_p"])
+        self._eos[slot] = np.int32(state["eos"])
+        ladder = state.get("ladder")
+        self._ladders[slot] = None if ladder is None else np.array(ladder)
+        self._cursor[slot] = int(state["cursor"])
+        if prompt is not None and self.prefix_cache:
+            self.allocator.register_prefix(
+                np.asarray(prompt, np.int32).reshape(-1), blocks
+            )
+        self._emit_pool_gauges()
+
+    def adopt_prefix_blocks(self, tokens, payload) -> int:
+        """Seed the LOCAL prefix cache with directory-fetched full-block
+        content (a chain prefetch): allocate, write, register, then
+        decref into the evictable cache. The next prefill of a prompt
+        starting with ``tokens``' leading blocks hits locally and
+        computes only its suffix. Returns the number of blocks seeded
+        (0 when already cached or no room — prefill then computes them,
+        which is always correct, just not free)."""
+        if self.allocator is None or not self.prefix_cache:
+            return 0
+        a = self.allocator
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        n = min(
+            (len(next(iter(payload.values()))) if payload else 0),
+            len(toks) // self.block_size,
+        )
+        if n < 1:
+            return 0
+        if a.peek_prefix(toks, n * self.block_size) >= n:
+            return 0
+        if a.free_count < n:
+            return 0
+        blocks = a.alloc(n)
+        self._import_block_payload(
+            blocks, {p: arr[:n] for p, arr in payload.items()}
+        )
+        a.register_prefix(toks[: n * self.block_size], blocks)
+        for bid in blocks:
+            a.decref(bid)
+        self._emit_pool_gauges()
+        return n

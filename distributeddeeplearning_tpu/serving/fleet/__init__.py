@@ -24,6 +24,7 @@ Certified by ``scripts/fleet_bench.py`` (``make fleet-bench``) and
 from distributeddeeplearning_tpu.serving.fleet.controller import (  # noqa: F401
     ControllerConfig,
     FleetController,
+    PoolWatermarks,
 )
 from distributeddeeplearning_tpu.serving.fleet.replica import (  # noqa: F401
     Replica,

@@ -100,11 +100,23 @@ class Replica:
         obs_dir: Optional[str] = None,
         run_id: Optional[str] = None,
         idle_sleep_s: float = 0.001,
+        pool: str = "mixed",
     ) -> None:
+        if pool not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"pool must be one of ('mixed', 'prefill', 'decode'), "
+                f"got {pool!r}"
+            )
         self.rid = int(rid)
         self.model = model
         self.params = params
         self.config = config or ServeConfig()
+        # Disaggregated serving (docs/SERVING.md): a pool-typed replica
+        # serves one phase. "prefill" runs prefills then exports each
+        # slot (Server handoff mode); "decode" never takes submissions
+        # — work arrives only through import_running. "mixed" is the
+        # colocated default (every existing fleet unchanged).
+        self.pool = pool
         self.max_len = max_len
         self.obs_dir = obs_dir
         self.run_id = run_id
@@ -185,6 +197,8 @@ class Replica:
         kw = dict(self.config.engine_kwargs())
         if self.max_len is not None:
             kw.setdefault("max_len", self.max_len)
+        if self.pool != "mixed":
+            kw["pool_role"] = self.pool
         engine = SlotEngine(self.model, self.params, **kw)
         engine.warmup()
         self.engine = engine
@@ -194,8 +208,9 @@ class Replica:
             prefills_per_step=self.config.prefills_per_step,
             default_deadline_ms=self.config.deadline_ms,
             admission_policy=self.config.build_admission_policy(),
+            handoff=(self.pool == "prefill"),
         )
-        obs.point("fleet.replica_ready", replica=self.rid)
+        obs.point("fleet.replica_ready", replica=self.rid, pool=self.pool)
 
     def _chaos_gate(self) -> bool:
         """Consult the chaos injector before a pump tick. Returns False
@@ -489,9 +504,30 @@ class Replica:
         with obs.bound_bus(self.bus):
             return self.server.reclaim_queued() if self.server else []
 
+    def inject_prefix(self, tokens: np.ndarray, payload) -> int:
+        """Directory chain prefetch: seed this replica's local prefix
+        cache with full-block KV content fetched from the fleet
+        directory, so the NEXT prefill of a prompt sharing those blocks
+        computes only its suffix. The pump is paused around the pool
+        write (allocator + pool mutation must not race a stepping
+        pump); inline replicas need no pause — the caller's thread IS
+        the pump. Returns blocks seeded (0 = skipped, always safe)."""
+        if self.engine is None or self.state not in ("ready", "draining"):
+            return 0
+        if self.threaded and not self.pause():
+            return 0  # pump never parked: skip, prefill computes it
+        try:
+            with obs.bound_bus(self.bus):
+                return self.engine.adopt_prefix_blocks(tokens, payload)
+        finally:
+            if self.threaded:
+                self.resume()
+
     def snapshot(self) -> Dict[str, Any]:
         """One row of the router's fleet view."""
         out: Dict[str, Any] = {"replica": self.rid, "state": self.state}
+        if self.pool != "mixed":
+            out["pool"] = self.pool
         if self.server is not None:
             out.update(
                 active=self.server.active_count,

@@ -67,7 +67,25 @@ Env contract (:meth:`FleetConfig.from_env`, docs/ORCHESTRATION.md):
 ``SERVE_QUARANTINE_TICKS``, ``SERVE_PUMP_HEARTBEAT_S``,
 ``SERVE_REPLICA_MAX_RESTARTS``, ``SERVE_REPLICA_RESTART_BACKOFF``,
 ``SERVE_FAULT_JOIN_S``, ``SERVE_BROWNOUT_STAGES``,
-``SERVE_CHAOS_PLAN``, ``SERVE_CHAOS_SEED``.
+``SERVE_CHAOS_PLAN``, ``SERVE_CHAOS_SEED``; disaggregation:
+``SERVE_DISAGG``, ``SERVE_POOL_PREFILL``, ``SERVE_POOL_DECODE``,
+``SERVE_DISAGG_DIRECTORY``, ``SERVE_DISAGG_PREFETCH``.
+
+**Disaggregated serving** (``SERVE_DISAGG=1``, docs/SERVING.md): the
+fleet splits into a *prefill pool* and a *decode pool*. A prefill
+replica admits, runs the bucketed prefill, delivers the first token,
+then exports the slot's state + KV block content and frees the slot —
+the router's handoff sweep seats the export on a decode replica as a
+RUNNING stream (no replay; the block table is the handoff unit), so a
+bursty long prompt never sits in anyone's decode tick. Greedy exports
+also publish into the fleet-wide :class:`PrefixDirectory`: a second
+consumer of an identical prompt **adopts** the entry (decode state
+transplanted straight from the directory — zero prefill programs run),
+and a prompt sharing only a full-block prefix **chain-prefetches**
+those blocks into its target replica's local cache. The same
+export/import machinery backs :meth:`Router.migrate` — scheduled live
+KV-block migration of a running stream between replicas, bitwise
+spliced, zero drops.
 """
 
 from __future__ import annotations
@@ -83,6 +101,10 @@ from typing import Any, Deque, Dict, List, Optional
 import numpy as np
 
 from distributeddeeplearning_tpu import obs
+from distributeddeeplearning_tpu.serving.blocks import (
+    BlockPoolExhausted,
+    PrefixDirectory,
+)
 from distributeddeeplearning_tpu.serving.chaos import SpliceMismatch
 from distributeddeeplearning_tpu.serving.fleet.replica import Replica
 from distributeddeeplearning_tpu.serving.scheduler import (
@@ -135,6 +157,16 @@ class FleetConfig:
     brownout_stages: str = ""
     chaos_plan: str = ""
     chaos_seed: int = 0
+    # Disaggregated prefill/decode pools (docs/SERVING.md): pool sizes
+    # of 0 auto-split (prefill gets floor(replicas/2), min 1); setting
+    # exactly one fixes that pool and the other takes the remainder.
+    # ``directory`` enables the fleet-wide prefix directory (adoption +
+    # chain prefetch); ``prefetch`` gates just the chain-prefetch leg.
+    disagg: bool = False
+    prefill_pool: int = 0
+    decode_pool: int = 0
+    directory: bool = True
+    prefetch: bool = True
 
     @classmethod
     def from_env(cls, env=None) -> "FleetConfig":
@@ -172,6 +204,13 @@ class FleetConfig:
             brownout_stages=str(e.get("SERVE_BROWNOUT_STAGES", "")),
             chaos_plan=str(e.get("SERVE_CHAOS_PLAN", "")),
             chaos_seed=int(e.get("SERVE_CHAOS_SEED", "0")),
+            disagg=_env_flag(e.get("SERVE_DISAGG"), cls.disagg),
+            prefill_pool=int(e.get("SERVE_POOL_PREFILL", cls.prefill_pool)),
+            decode_pool=int(e.get("SERVE_POOL_DECODE", cls.decode_pool)),
+            directory=_env_flag(
+                e.get("SERVE_DISAGG_DIRECTORY"), cls.directory
+            ),
+            prefetch=_env_flag(e.get("SERVE_DISAGG_PREFETCH"), cls.prefetch),
         )
 
     def validate(self) -> None:
@@ -212,6 +251,53 @@ class FleetConfig:
             )
 
             parse_chaos_plan(self.chaos_plan)
+        if self.prefill_pool < 0 or self.decode_pool < 0:
+            raise ValueError(
+                "SERVE_POOL_PREFILL and SERVE_POOL_DECODE must be >= 0"
+            )
+        if self.disagg:
+            if self.replicas < 2:
+                raise ValueError(
+                    f"SERVE_DISAGG needs >= 2 replicas (one per pool), "
+                    f"got {self.replicas}"
+                )
+            pre, dec = self.pool_split()
+            if pre < 1 or dec < 1:
+                raise ValueError(
+                    f"pool split {pre}+{dec} must leave at least one "
+                    f"replica in each pool (SERVE_REPLICAS="
+                    f"{self.replicas}, SERVE_POOL_PREFILL="
+                    f"{self.prefill_pool}, SERVE_POOL_DECODE="
+                    f"{self.decode_pool})"
+                )
+            if pre + dec != self.replicas:
+                raise ValueError(
+                    f"SERVE_POOL_PREFILL + SERVE_POOL_DECODE = "
+                    f"{pre + dec} != SERVE_REPLICAS {self.replicas}"
+                )
+
+    def pool_split(self) -> "tuple[int, int]":
+        """``(prefill, decode)`` replica counts under ``disagg``
+        (``(0, 0)`` otherwise). Unset pools auto-split."""
+        if not self.disagg:
+            return (0, 0)
+        n = self.replicas
+        if self.prefill_pool and self.decode_pool:
+            return (self.prefill_pool, self.decode_pool)
+        if self.prefill_pool:
+            return (self.prefill_pool, n - self.prefill_pool)
+        if self.decode_pool:
+            return (n - self.decode_pool, self.decode_pool)
+        pre = max(n // 2, 1)
+        return (pre, n - pre)
+
+
+def _env_flag(raw: Optional[str], default: bool) -> bool:
+    """``"1"/"true"/"yes"/"on"`` → True, ``"0"/"false"/"no"/"off"`` →
+    False, unset/empty → ``default``."""
+    if raw is None or str(raw).strip() == "":
+        return bool(default)
+    return str(raw).strip().lower() not in ("0", "false", "no", "off")
 
 
 def parse_tenant_weights(text: str) -> Dict[str, float]:
@@ -350,9 +436,19 @@ class FleetHandle:
 
     # -- router side -------------------------------------------------------
 
-    def _attach(self, sub: RequestHandle, replica_id: int) -> None:
+    def _attach(self, sub: RequestHandle, replica_id: int,
+                seen: int = 0) -> None:
+        """Bind one replica attempt. ``seen`` is how many of this
+        handle's delivered tokens the attempt ALREADY accounts for: a
+        from-scratch dispatch replays from token 0 (``seen=0``, every
+        replayed token verified against the delivered prefix), while a
+        handoff/migration continuation was seeded with the delivered
+        prefix (``import_running(prior_tokens=...)``) and emits only
+        fresh tokens — ``seen=len(new_tokens)`` keeps the splice
+        cursor exact so the continuation neither re-verifies nor
+        mis-indexes."""
         self._sub = sub
-        self._sub_seen = 0
+        self._sub_seen = seen
         self._sub_tainted = False
         self.replica_id = replica_id
         self.attempts += 1
@@ -489,11 +585,23 @@ class Router:
         self._shed_tenants: set = set()
         self._shed_by_stage: Dict[int, set] = {}
         self._brownout_max_new: Optional[int] = None
+        # Disaggregation plane (docs/SERVING.md): the fleet-wide prefix
+        # directory (greedy prefill exports publish; adoptions and
+        # chain prefetches consume) and the prefill→decode handoff
+        # queue — exports waiting for a decode replica with room.
+        # Entries retry every tick until seated: backpressure, never a
+        # drop.
+        self.directory: Optional[PrefixDirectory] = (
+            PrefixDirectory()
+            if self.config.disagg and self.config.directory else None
+        )
+        self._pending_handoffs: Deque[Any] = collections.deque()
         self.stats: Dict[str, Any] = {
             "submitted": 0, "dispatched": 0, "requeued": 0, "completed": 0,
             "rejected": 0, "cancelled": 0, "deadline": 0,
             "quarantined": 0, "unquarantined": 0, "splice_mismatch": 0,
             "breaker_open": 0, "rejoins": 0, "brownout": 0,
+            "handoffs": 0, "migrations": 0, "directory_hits": 0,
         }
         for r in replicas or []:
             self.add_replica(r, start=False)
@@ -568,6 +676,11 @@ class Router:
                 error=repr(error) if error else "declared_failed",
                 exit_code=replica.exit_code, retryable=True,
             )
+        if self.directory is not None:
+            # The dead replica's blocks are gone with its engine:
+            # re-home each entry to a surviving holder or drop it.
+            # Payload-backed adoption keeps working either way.
+            self.directory.drop_replica(rid)
         return self._requeue_from(replica, running_too=True, cause="splice")
 
     def quarantine_replica(self, rid: int, **labels: Any) -> int:
@@ -614,6 +727,8 @@ class Router:
         replica.stop(timeout=self.config.fault_join_s)
         replica.state = "removed"
         self.replicas = [r for r in self.replicas if r.rid != rid]
+        if self.directory is not None:
+            self.directory.drop_replica(rid)
         obs.point("fleet.replica_remove", replica=rid)
         return replica
 
@@ -687,6 +802,21 @@ class Router:
         next dispatch, which emits the re-route child span under the
         request's trace."""
         subs = replica.reclaim_queued()
+        if replica.server is not None and replica.server.handoff:
+            # Pending prefill exports are pure host data: they outlive
+            # this replica (fault or drain alike), so hand them to the
+            # handoff queue instead of replaying the prefill — the
+            # lossless half of "a prefill replica dying mid-handoff".
+            alive = replica.state not in ("faulted", "removed")
+            for sub, state in replica.server.take_handoffs():
+                fh = self._fh_for_sub(sub)
+                if fh is not None:
+                    self._publish_handoff(
+                        replica.rid, fh, state, resident=alive
+                    )
+                    self._pending_handoffs.append(
+                        (fh, state, replica.rid, "handoff")
+                    )
         if running_too and replica.server is not None:
             # The replica's private event stream must see the
             # trace_close for the running work being taken from it.
@@ -790,12 +920,15 @@ class Router:
         for r in self.replicas:
             if not r.threaded:
                 busy = r.pump_once() or busy
+        self._handoff_sweep(time.monotonic())
         self._finish_sweep()
         with self._lock:
             backlog = sum(len(t.queue) for t in self._tenants.values())
             inflight = len(self._inflight)
         self._emit_gauges(backlog, inflight)
-        return bool(backlog or inflight or busy)
+        return bool(
+            backlog or inflight or busy or self._pending_handoffs
+        )
 
     def _chaos_tick(self, now: float) -> None:
         """Activate the drill directives due at this tick: pump verbs
@@ -1037,8 +1170,13 @@ class Router:
             for t in tenants:
                 t.deficit = 0.0
             return
+        # Admission capacity = slots that can PREFILL. Decode-pool
+        # replicas never take submissions (their work arrives through
+        # the handoff sweep), so they are invisible here; adoptions
+        # bypass this budget entirely (no prefill slot is consumed).
         capacity = sum(
-            r.free_slot_count() for r in self.replicas if r.placeable
+            r.free_slot_count() for r in self.replicas
+            if r.placeable and r.pool != "decode"
         )
         idle_visits = 0
         while capacity > 0 and idle_visits <= len(tenants):
@@ -1059,6 +1197,15 @@ class Router:
                 cost = float(fh.request.max_new_tokens)
                 if t.deficit < cost:
                     break
+                if self._try_adopt(fh, now):
+                    # Directory hit: the stream was seated straight on
+                    # a decode replica (or finished outright) — no
+                    # prefill slot consumed, so `capacity` is untouched.
+                    with self._lock:
+                        t.queue.popleft()
+                    t.deficit -= cost
+                    served += 1
+                    continue
                 replica = self._place(fh)
                 if replica is None:
                     blocked = True  # no replica can admit this request
@@ -1081,7 +1228,8 @@ class Router:
     def _place(self, fh: FleetHandle) -> Optional[Replica]:
         spec = fh.request.spec()
         candidates = [
-            r for r in self.replicas if r.placeable and r.can_take(spec)
+            r for r in self.replicas
+            if r.pool != "decode" and r.placeable and r.can_take(spec)
         ]
         if not candidates:
             return None
@@ -1107,6 +1255,19 @@ class Router:
         return max(candidates, key=score)
 
     def _dispatch_to(self, replica: Replica, fh: FleetHandle) -> None:
+        if self.directory is not None and replica.pool == "prefill":
+            if (
+                replica.server is not None and replica.engine is not None
+                and replica.engine.prefix_cache
+            ):
+                # Arm pin-at-export before any request reaches the
+                # server: the pump pins a greedy export's full prefix
+                # blocks on its own thread, so every block the
+                # directory maps stays resident (never a router-thread
+                # allocator mutation racing an eviction).
+                replica.server.handoff_pin = True
+            if self.config.prefetch:
+                self._chain_prefetch(replica, fh)
         max_new = fh.request.max_new_tokens
         if self._brownout_max_new is not None:
             # Brownout cap applies at dispatch (new placements only —
@@ -1156,6 +1317,373 @@ class Router:
         fh._reroute_cause = None
         fh._requeued_t = None
         fh._reroute_from = None
+
+    # -- disaggregation: handoff, directory, migration ---------------------
+
+    def _fh_for_sub(self, sub: RequestHandle) -> Optional[FleetHandle]:
+        with self._lock:
+            for fh in self._inflight:
+                if fh._sub is sub:
+                    return fh
+        return None
+
+    def _publish_handoff(self, rid: int, fh: FleetHandle,
+                         state: Dict[str, Any], *,
+                         resident: bool = True) -> None:
+        """Publish a greedy prefill export into the fleet directory.
+        ``resident=False`` (the exporter is faulted) publishes payload
+        only — the directory must never map blocks on a dead engine."""
+        if self.directory is None or float(state["temp"]) != 0.0:
+            return
+        bids = state.get("pinned", []) if resident else []
+        self.directory.publish(
+            rid, fh.request.prompt, bids, state["payload"],
+            first_token=int(state["token"]),
+            block_size=int(state["block_size"]),
+        )
+
+    def _chain_prefetch(self, replica: Replica, fh: FleetHandle) -> None:
+        """Directory chain prefetch: when the fleet holds more leading
+        full blocks of this prompt than ``replica`` does locally, seed
+        them into its prefix cache before the submit — the prefill then
+        computes only the divergent suffix (prefill-once-per-fleet for
+        shared prefixes, not just identical prompts)."""
+        eng = replica.engine
+        if eng is None or eng.allocator is None or not eng.prefix_cache:
+            return
+        n, ent, payload = self.directory.lookup_chain(
+            fh.request.prompt, eng.block_size
+        )
+        if ent is None or n < 1:
+            return
+        if replica.prefix_hit_blocks(fh.request.prompt) >= n:
+            return
+        prompt = np.asarray(fh.request.prompt, np.int32).reshape(-1)
+        seeded = replica.inject_prefix(
+            prompt[: n * eng.block_size], payload
+        )
+        if seeded:
+            self.stats["directory_hits"] += 1
+            with obs.trace_ctx(fh.trace):
+                obs.counter(
+                    "serve.directory_hits", req=fh.id, kind="prefetch",
+                    blocks=seeded,
+                )
+
+    def _try_adopt(self, fh: FleetHandle, now: float) -> bool:
+        """Fleet-wide prefix directory fast path: an identical greedy
+        prompt already prefilled somewhere in the fleet is ADOPTED —
+        decode state transplanted straight from the directory entry
+        onto a decode replica, zero prefill programs run. Returns True
+        when the handle was seated (or finished outright); False falls
+        through to normal placement."""
+        if self.directory is None or fh.new_tokens or fh._cancel:
+            return False
+        req = fh.request
+        if float(req.temperature) != 0.0:
+            return False
+        ent = self.directory.lookup(req.prompt)
+        if ent is None:
+            return False
+        first = int(ent["first_token"])
+        eos = -1 if req.eos_token is None else int(req.eos_token)
+        if first == eos or req.max_new_tokens <= 1:
+            # The adopted stream is already complete: deliver the
+            # deterministic first token and finish locally.
+            self.directory.adopt(req.prompt)
+            self.stats["directory_hits"] += 1
+            with obs.trace_ctx(fh.trace):
+                obs.counter(
+                    "serve.directory_hits", req=fh.id, kind="adopt"
+                )
+            fh._ingest([first])
+            self._complete_local(fh, "eos" if first == eos else "length")
+            return True
+        bs = int(ent["block_size"])
+        t = int(np.asarray(req.prompt).reshape(-1).shape[0])
+        # Same budget prefill would have allocated (decode-pool engines
+        # run spec_k=0): positions 0 .. t + max_new - 2.
+        need = -(-(t + int(req.max_new_tokens) - 1) // bs)
+        state = {
+            "block_size": bs,
+            "n_blocks": need,
+            "blocks": [],
+            "written": t,
+            "token": first,
+            "temp": 0.0,
+            "top_k": 0,
+            "top_p": 0.0,
+            "eos": eos,
+            "ladder": None,
+            "cursor": 1,
+            "payload": ent["payload"],
+            "handoff_t": now,
+        }
+        dst = self._decode_target(state)
+        if dst is None:
+            return False  # no decode room: the prefill path keeps liveness
+        self.directory.adopt(req.prompt)
+        self.stats["directory_hits"] += 1
+        with obs.trace_ctx(fh.trace):
+            obs.counter("serve.directory_hits", req=fh.id, kind="adopt")
+        fh._ingest([first])
+        if not self._import_to(
+            dst, fh, state, cause="handoff", src=int(ent["owner"]), now=now
+        ):
+            # Lost the room mid-import. The delivered first token is
+            # safe: a from-scratch dispatch re-verifies it (splice).
+            return False
+        return True
+
+    def _complete_local(self, fh: FleetHandle, reason: str) -> None:
+        """Finish a handle the router itself completed (adoption edge
+        cases) with exactly the accounting ``_finish_sweep`` does."""
+        with self._lock:
+            if fh in self._inflight:
+                self._inflight.remove(fh)
+        t = self._tenant(fh.tenant)
+        t.completed += 1
+        t.tokens_done += len(fh.new_tokens)
+        self.stats["completed"] += 1
+        with obs.trace_ctx(fh.trace):
+            obs.counter("fleet.completed", tenant=fh.tenant)
+            obs.counter(
+                "fleet.tenant_tokens", len(fh.new_tokens), tenant=fh.tenant
+            )
+        fh._finish(reason)
+
+    def _handoff_sweep(self, now: float) -> None:
+        """Collect prefill exports, publish greedy ones to the
+        directory, and seat every pending export on a decode replica.
+        An export with no room retries next tick — backpressure, never
+        a drop; ``_requeue_from`` feeds this same queue when a prefill
+        replica faults mid-handoff (the export is host data and
+        outlives its producer)."""
+        if not self.config.disagg:
+            return
+        for r in self.replicas:
+            if r.pool != "prefill" or r.server is None:
+                continue
+            for sub, state in r.server.take_handoffs():
+                fh = self._fh_for_sub(sub)
+                if fh is None:
+                    continue  # handle already finished: drop the export
+                self._publish_handoff(r.rid, fh, state)
+                self._pending_handoffs.append(
+                    (fh, state, r.rid, "handoff")
+                )
+                self.stats["handoffs"] += 1
+        retry: Deque[Any] = collections.deque()
+        while self._pending_handoffs:
+            fh, state, src, cause = self._pending_handoffs.popleft()
+            if fh.done.is_set():
+                continue
+            if fh._cancel:
+                self._drop_handoff(fh)
+                continue
+            if fh.expired(now):
+                # No replica owns a parked export, so the router is
+                # the one enforcing its deadline.
+                self._drop_handoff(fh, reason="deadline")
+                continue
+            dst = self._decode_target(state)
+            if dst is None or not self._import_to(
+                dst, fh, state, cause=cause, src=src, now=now
+            ):
+                retry.append((fh, state, src, cause))
+        self._pending_handoffs = retry
+
+    def _drop_handoff(self, fh: FleetHandle,
+                      reason: str = "cancelled") -> None:
+        """Cancel/deadline-mid-handoff: the exported blocks were
+        already released at export and the payload is host data, so
+        dropping the state leaks nothing — only the handle needs its
+        terminal accounting."""
+        with self._lock:
+            if fh in self._inflight:
+                self._inflight.remove(fh)
+        if not fh.done.is_set():
+            key = "cancelled" if reason == "cancelled" else "deadline"
+            self.stats[key] += 1
+            with obs.trace_ctx(fh.trace):
+                obs.counter(
+                    "serve.cancelled" if reason == "cancelled"
+                    else "serve.evicted_deadline",
+                    tenant=fh.tenant,
+                )
+            fh._finish(reason)
+
+    def _decode_target(self, state: Dict[str, Any],
+                       exclude: Optional[int] = None) -> Optional[Replica]:
+        """Best decode-capable replica that can seat ``state`` right
+        now (free slot + allocatable blocks), least-loaded first."""
+        cands = [
+            r for r in self.replicas
+            if r.pool in ("decode", "mixed") and r.placeable
+            and r.rid != exclude and r.engine is not None
+            and r.engine.can_import(state)
+        ]
+        if not cands:
+            return None
+
+        def score(r: Replica) -> float:
+            ld = r.load()
+            return ld["free_slots"] + ld["free_blocks"]
+
+        return max(cands, key=score)
+
+    def _import_to(self, replica: Replica, fh: FleetHandle,
+                   state: Dict[str, Any], *, cause: str,
+                   src: Optional[int], now: float) -> bool:
+        """Seat an exported slot state on ``replica`` as a RUNNING
+        stream and splice the fleet handle onto it. The pump is parked
+        around the import (slot + pool mutation must not race a
+        stepping pump); the new attempt is seeded with the delivered
+        prefix and attached at ``seen=len(prefix)`` so it emits only
+        fresh tokens. Returns False when the import lost its room —
+        the caller retries elsewhere or later."""
+        if replica.threaded and not replica.pause(
+            timeout=self.config.fault_join_s
+        ):
+            return False
+        try:
+            prior = list(fh.new_tokens)
+            req = dataclasses.replace(
+                fh.request,
+                on_token=lambda _h, toks, fh=fh: fh._ingest(toks),
+                trace=fh.trace,
+                deadline_ms=(
+                    None if fh._deadline_t is None
+                    else max(
+                        (fh._deadline_t - time.monotonic()) * 1e3, 1.0
+                    )
+                ),
+            )
+            try:
+                with obs.bound_bus(replica.bus):
+                    sub = replica.server.import_running(
+                        req, state, prior_tokens=prior
+                    )
+            except (RuntimeError, BlockPoolExhausted):
+                return False
+        finally:
+            if replica.threaded:
+                replica.resume()
+        fh._attach(sub, replica.rid, seen=len(prior))
+        with self._lock:
+            if fh not in self._inflight:
+                self._inflight.append(fh)
+        dur = max(now - float(state.get("handoff_t", now)), 0.0)
+        span = (
+            "fleet.migration" if cause == "migration" else "fleet.handoff"
+        )
+        with obs.trace_ctx(fh.trace, cause=cause):
+            obs.span_event(
+                span, dur, req=fh.id, replica=replica.rid, src=src,
+                attempt=fh.attempts,
+            )
+            if cause == "migration":
+                obs.counter("serve.migrations")
+            else:
+                obs.gauge("serve.handoff_ms", round(dur * 1e3, 3))
+        return True
+
+    def migrate(self, src_rid: int, dst_rid: Optional[int] = None,
+                *, max_streams: int = 1) -> int:
+        """Scheduled live KV-block migration (docs/SERVING.md): move up
+        to ``max_streams`` running streams off replica ``src_rid`` as
+        state transplants — export under a parked pump, import on
+        ``dst_rid`` (or the best-fit decode-capable replica), splice
+        bitwise at the exact delivered token, zero drops. The splice
+        machinery that heals faults, now a first-class operation:
+        defragment a pool, empty a replica before drain, rebalance.
+        A stream that finds no import room falls back to the
+        requeue-replay path (still lossless — the splice verifies the
+        replayed prefix). Returns the number of streams moved by
+        transplant. Paged, non-speculative engines only
+        (``export_slot`` contract)."""
+        if dst_rid is not None and dst_rid == src_rid:
+            raise ValueError("migrate needs distinct src and dst replicas")
+        src = self._replica(src_rid)
+        if src.server is None:
+            return 0
+        if src.threaded and not src.pause(
+            timeout=self.config.fault_join_s
+        ):
+            raise TimeoutError(
+                f"replica {src_rid} pump unresponsive to migrate pause"
+            )
+        moved = 0
+        now = time.monotonic()
+        try:
+            with self._lock:
+                live = [
+                    fh for fh in self._inflight
+                    if fh.replica_id == src_rid and fh._sub is not None
+                    and not fh.done.is_set()
+                ]
+            for fh in live[:max_streams]:
+                with obs.bound_bus(src.bus):
+                    state = src.server.export_running(fh._sub)
+                if state is None:
+                    continue  # not running here (handoff-parked, raced)
+                dst = (
+                    self._replica(dst_rid) if dst_rid is not None
+                    else self._decode_target(state, exclude=src_rid)
+                )
+                if dst is not None and self._import_to(
+                    dst, fh, state, cause="migration", src=src_rid,
+                    now=now,
+                ):
+                    moved += 1
+                    self.stats["migrations"] += 1
+                    continue
+                # No destination (or it lost its room): requeue-replay.
+                with self._lock:
+                    if fh in self._inflight:
+                        self._inflight.remove(fh)
+                fh._detach()
+                fh._reroute_cause = "migration"
+                fh._requeued_t = now
+                fh._reroute_from = src_rid
+                with self._lock:
+                    self._tenant(fh.tenant).queue.appendleft(fh)
+                    self.stats["requeued"] += 1
+        finally:
+            if src.threaded:
+                src.resume()
+        return moved
+
+    def pool_pressure(self, pool: str) -> float:
+        """Per-pool autoscale signal (the FleetController's per-pool
+        watermarks): prefill pressure is the admission backlog over
+        prefill slots, decode pressure is seated streams plus pending
+        handoffs over decode slots; both saturate on KV blocks —
+        :meth:`pressure` semantics, restricted to one pool."""
+        ready = [
+            r for r in self.replicas if r.placeable and r.pool == pool
+        ]
+        slots = sum(r.engine.num_slots for r in ready)
+        if pool == "prefill":
+            with self._lock:
+                demand = sum(
+                    len(t.queue) for t in self._tenants.values()
+                )
+            demand += sum(
+                r.server.active_count + r.server.queued_count
+                for r in ready
+            )
+        else:
+            demand = len(self._pending_handoffs) + sum(
+                r.server.active_count + r.server.queued_count
+                for r in ready
+            )
+        p = demand / max(slots, 1)
+        for r in ready:
+            a = r.engine.allocator
+            if a is not None:
+                p = max(p, 1.0 - a.free_count / max(a.capacity, 1))
+        return p
 
     # -- brownout ladder actions (scheduler.BrownoutLadder drives) ---------
 
@@ -1266,6 +1794,21 @@ class Router:
             "fleet.brownout_stage",
             float(self.brownout.level) if self.brownout is not None else 0.0,
         )
+        if self.config.disagg:
+            obs.gauge(
+                "fleet.prefill_replicas",
+                float(sum(
+                    1 for r in self.replicas
+                    if r.pool == "prefill" and r.placeable
+                )),
+            )
+            obs.gauge(
+                "fleet.decode_replicas",
+                float(sum(
+                    1 for r in self.replicas
+                    if r.pool == "decode" and r.placeable
+                )),
+            )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1328,10 +1871,15 @@ def build_fleet(
     if obs_dir is None:
         obs_dir = os.environ.get("OBS_DIR") or None
     router = Router(config=fcfg)
+    npre, _ = fcfg.pool_split()
     for k in range(fcfg.replicas):
+        pool = "mixed"
+        if fcfg.disagg:
+            pool = "prefill" if k < npre else "decode"
         router.add_replica(
             Replica(
-                k, model, params, scfg, max_len=max_len, obs_dir=obs_dir
+                k, model, params, scfg, max_len=max_len, obs_dir=obs_dir,
+                pool=pool,
             ),
             start=start, threaded=threaded,
         )

@@ -10,7 +10,8 @@ variant. This module is the one copy:
   mixes (``SERVE_PROFILE``): ``mixed`` cycles a handful of prompt
   lengths at one ``max_new``; ``longtail`` is the production-shaped
   distribution (mostly short prompts, a thin tail of long ones) the
-  paged pool exists for.
+  paged pool exists for; ``disagg`` is the bimodal
+  long-prefill/long-decode storm the disaggregated fleet splits.
 * :func:`build_requests` — seeded request set + Poisson arrival
   offsets over a shape mix. Deterministic in ``seed``: every protocol
   comparing two configurations replays the *same* load.
@@ -42,6 +43,16 @@ PROFILES: Dict[str, Optional[List[Tuple[int, int]]]] = {
         + [(12, 16)] * 3 + [(16, 16)] * 2
         + [(24, 16), (48, 24), (96, 32)]
     ),
+    # Bimodal disaggregation storm: long-prefill/short-decode requests
+    # (prefill-bound) interleaved with short-prefill/long-decode ones
+    # (decode-bound). Under a colocated fleet the long decodes hold
+    # slots and queue the long prefills behind them; a split fleet
+    # serves each mode from its own pool. Few distinct shapes keeps the
+    # sequential baseline's warmup (and the closed program set) small.
+    "disagg": (
+        [(96, 12)] * 4 + [(64, 12)] * 3
+        + [(6, 48)] * 4 + [(4, 32)] * 3 + [(8, 48)] * 2
+    ),
 }
 MIXED_PROMPT_LENS: Tuple[int, ...] = (4, 7, 12, 5, 16, 3, 9, 14)
 
@@ -70,17 +81,35 @@ def percentile(vals: Sequence[float], q: float) -> float:
     return vals[idx]
 
 
+def hot_prompt(vocab: int, length: int, seed: int = 0):
+    """The deterministic "hot system prompt": every caller with the
+    same (vocab, length, seed) gets the bitwise-identical token run, so
+    a shared prefix built from it hashes to the same directory chain on
+    every replica (scripts/disagg_bench.py)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, size=(length,)).astype(np.int32)
+
+
 def build_requests(
     n: int, rate_rps: float, seed: int, vocab: int,
     shapes: Sequence[Tuple[int, int]],
+    shared_prefix=None,
 ) -> List[Dict[str, Any]]:
     """Seeded request set + Poisson arrival offsets (seconds) over the
     (prompt_len, max_new) shape mix — mixed lengths, per-request
     sampling seeds: the adversarial mix the parity oracles certify, at
     load. ``rate_rps == 0`` is the closed-backlog special case (all
-    arrivals at t=0)."""
+    arrivals at t=0). ``shared_prefix`` (a token array, e.g.
+    :func:`hot_prompt`) is prepended to every prompt — the "hot system
+    prompt" shape the fleet prefix directory amortises; per-request
+    tails stay distinct so only the prefix blocks are shareable."""
     import numpy as np
 
+    pre = None
+    if shared_prefix is not None:
+        pre = np.asarray(shared_prefix).reshape(-1).astype(np.int32)
     rng = np.random.RandomState(seed)
     order = rng.permutation(len(shapes))
     reqs = []
@@ -89,9 +118,12 @@ def build_requests(
         if rate_rps > 0:
             t += float(rng.exponential(1.0 / rate_rps))
         tp, max_new = shapes[order[i % len(shapes)]]
+        prompt = rng.randint(0, vocab, size=(tp,)).astype(np.int32)
+        if pre is not None:
+            prompt = np.concatenate([pre, prompt])
         reqs.append({
             "arrival_s": t,
-            "prompt": rng.randint(0, vocab, size=(tp,)).astype(np.int32),
+            "prompt": prompt,
             "max_new": int(max_new),
             "seed": int(rng.randint(0, 2**31 - 1)),
         })
@@ -101,6 +133,7 @@ def build_requests(
 def build_tenant_requests(
     tenant_ids: Sequence[str], n: int, rate_rps: float, seed: int,
     vocab: int, shapes: Sequence[Tuple[int, int]],
+    shared_prefix=None,
 ) -> List[Dict[str, Any]]:
     """:func:`build_requests` with a ``tenant`` identity cycled over the
     stream. Round-robin assignment means every tenant offers the same
@@ -108,7 +141,9 @@ def build_tenant_requests(
     under contention, each tenant's *completed* share is then pinned by
     the router's weights alone, which is exactly what the fairness gate
     measures (scripts/fleet_bench.py, docs/SERVING.md)."""
-    reqs = build_requests(n, rate_rps, seed, vocab, shapes)
+    reqs = build_requests(
+        n, rate_rps, seed, vocab, shapes, shared_prefix=shared_prefix
+    )
     for i, r in enumerate(reqs):
         r["tenant"] = str(tenant_ids[i % len(tenant_ids)])
     return reqs

@@ -676,6 +676,7 @@ class Server:
         prefills_per_step: int = 1,
         default_deadline_ms: Optional[float] = None,
         admission_policy: Optional[AdmissionPolicy] = None,
+        handoff: bool = False,
     ) -> None:
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
@@ -683,7 +684,29 @@ class Server:
             raise ValueError(
                 f"prefills_per_step must be >= 1, got {prefills_per_step}"
             )
+        if handoff and engine.allocator is None:
+            raise ValueError(
+                "handoff mode requires kv_layout='paged' (the block "
+                "table is the handoff unit)"
+            )
         self.engine = engine
+        # Disaggregated prefill pool (docs/SERVING.md): after the first
+        # token, export the slot's state + KV blocks and free the slot
+        # instead of decoding here — the fleet router collects the
+        # export (take_handoffs) and seats it on a decode replica.
+        self.handoff = bool(handoff)
+        self._handoffs: List[Tuple[RequestHandle, Dict[str, Any]]] = []
+        # Directory pin plane: the fleet router flips handoff_pin on
+        # (before any request reaches this server) when it owns a
+        # PrefixDirectory, and greedy exports then pin their full
+        # prefix blocks HERE, on the pump thread, before the slot is
+        # released — a pin from the router thread could race an
+        # in-flight eviction. The budget bounds how much of the pool a
+        # storm of distinct hot prompts can nail down; past it the
+        # export still publishes (payload rides the state), it just
+        # maps no resident blocks.
+        self.handoff_pin = False
+        self._handoff_pins = 0
         self.queue_depth = queue_depth
         # The policy-adjustable knobs: queue_limit is the *effective*
         # QueueFull threshold (<= queue_depth, the configured ceiling);
@@ -874,6 +897,39 @@ class Server:
             if eos_hit or len(handle.new_tokens) >= spec.max_new_tokens:
                 self.engine.release(slot)
                 self._finish(handle, "eos" if eos_hit else "length")
+            elif self.handoff:
+                # Disaggregated prefill: the slot's job here is done the
+                # moment the first token exists. Export state + blocks,
+                # free the slot for the next prefill, and park the
+                # handle for the router's handoff sweep. The trace
+                # leaves with the export (the decode replica re-opens
+                # it); ``handoff_t`` anchors the serve.handoff_ms
+                # window.
+                state = self.engine.export_slot(slot)
+                state["handoff_t"] = time.monotonic()
+                if (
+                    self.handoff_pin
+                    and float(state["temp"]) == 0.0
+                    and self.engine.allocator is not None
+                ):
+                    alloc = self.engine.allocator
+                    nfull = (
+                        int(np.asarray(handle.request.prompt).reshape(-1)
+                            .shape[0]) // state["block_size"]
+                    )
+                    bids = list(state["blocks"][:nfull])
+                    fresh = [b for b in bids if not alloc.pinned(b)]
+                    budget = alloc.capacity // 4
+                    if bids and self._handoff_pins + len(fresh) <= budget:
+                        for b in bids:
+                            alloc.pin(b)
+                        self._handoff_pins += len(fresh)
+                        state["pinned"] = bids
+                self.engine.release(slot)
+                handle.status = "handoff"
+                obs.trace_close(handle.trace)
+                with self._lock:
+                    self._handoffs.append((handle, state))
             else:
                 self._by_slot[slot] = handle
 
@@ -1006,6 +1062,70 @@ class Server:
             obs.trace_close(h.trace)
             out.append(h)
         return out
+
+    def take_handoffs(self) -> List[Tuple[RequestHandle, Dict[str, Any]]]:
+        """Collect every pending prefill export (handoff mode). Safe
+        from any thread — the router calls this each tick and seats the
+        exports on decode replicas. Exports are pure host data, so they
+        survive this replica's death: anything already collected can be
+        imported anywhere."""
+        with self._lock:
+            out = self._handoffs
+            self._handoffs = []
+        return out
+
+    def export_running(
+        self, handle: RequestHandle
+    ) -> Optional[Dict[str, Any]]:
+        """Live migration export: snapshot ``handle``'s slot state + KV
+        blocks (:meth:`SlotEngine.export_slot`), release the slot, and
+        park the handle (status → ``requeued``). Unlike
+        :meth:`take_running`, the export makes the continuation a state
+        transplant — the importing replica replays nothing. Only call
+        with the pump parked. Returns None when the handle is not
+        running here."""
+        for slot, h in list(self._by_slot.items()):
+            if h is handle:
+                state = self.engine.export_slot(slot)
+                state["handoff_t"] = time.monotonic()
+                self.engine.release(slot)
+                del self._by_slot[slot]
+                h.status = "requeued"
+                obs.trace_close(h.trace)
+                return state
+        return None
+
+    def import_running(
+        self,
+        request: Request,
+        state: Dict[str, Any],
+        prior_tokens: Optional[List[int]] = None,
+    ) -> RequestHandle:
+        """Seat an exported slot state (handoff or migration) as a
+        RUNNING request — no queue, no prefill: the engine restores the
+        KV blocks and sampling cursor and the next decode tick continues
+        the stream bitwise. ``prior_tokens`` seeds the handle with the
+        tokens earlier attempts already delivered so the finish
+        condition (``len(new_tokens) >= max_new_tokens``) and the
+        stream splice stay exact. Raises when no slot/blocks are free —
+        the caller checked :meth:`SlotEngine.can_import` first."""
+        if self._closed:
+            raise RuntimeError("server is closed")
+        free = self.engine.free_slots
+        if not free:
+            raise RuntimeError("no free slot for import")
+        now = time.monotonic()
+        slot = free[0]
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        self.engine.import_slot(slot, state, prompt=prompt)
+        handle = RequestHandle(request, next(self._ids), now)
+        handle.status = "running"
+        handle.queue_wait_s = 0.0
+        if prior_tokens:
+            handle.new_tokens = [int(t) for t in prior_tokens]
+        self._by_slot[slot] = handle
+        obs.trace_open(handle.trace, req=handle.id)
+        return handle
 
     @property
     def queued_count(self) -> int:

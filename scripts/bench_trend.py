@@ -24,6 +24,9 @@ chaos-free records normalize to no plan), pairs whose ``coloc``
 knob string differs (a re-arbitrated pool — different geometry,
 shrink step, or surge window — is a new colocation protocol —
 ``coloc_change`` skip; non-colocated records normalize to none),
+pairs whose disaggregation ``pool_split`` differs (re-drawing the
+prefill/decode pool boundary is a new serving protocol —
+``disagg_change`` skip; colocated records normalize to none),
 and pairs whose
 ``decode_kernel`` changed (the fused Pallas decode path vs the stitched
 XLA lowering is a different machine program per token —
@@ -162,6 +165,13 @@ def analyze(
             # (``coloc_change`` skip), never a regression.
             # Non-colocated records normalize to "".
             "coloc": str(detail.get("coloc") or ""),
+            # The disaggregation pool split (disagg_bench's
+            # `pool_split` detail, e.g. "prefill:2,decode:2"): moving
+            # replicas between the prefill and decode pools re-shapes
+            # which phase each engine serves — a new serving protocol
+            # (``disagg_change`` skip), never a regression. Colocated
+            # records normalize to "".
+            "pools": str(detail.get("pool_split") or ""),
             # An elastic world resize is the training-side analog: the
             # same metric over a different device count is a new
             # baseline (``world_change`` skip). Pre-elastic records
@@ -190,6 +200,7 @@ def analyze(
                 and prev["data_format"] == row["data_format"]
                 and prev["chaos"] == row["chaos"]
                 and prev["coloc"] == row["coloc"]
+                and prev["pools"] == row["pools"]
             ):
                 delta = (value - prev["value"]) / prev["value"]
                 row["delta_pct"] = round(delta * 100.0, 2)
@@ -239,6 +250,11 @@ def analyze(
                     f"coloc_change:"
                     f"{prev['coloc'] or 'none'}->{row['coloc'] or 'none'}"
                 )
+            elif prev is not None and prev["pools"] != row["pools"]:
+                row["skip"] = (
+                    f"disagg_change:"
+                    f"{prev['pools'] or 'none'}->{row['pools'] or 'none'}"
+                )
             elif prev is not None:
                 row["skip"] = (
                     f"world_change:{prev['world'] or 'unspecified'}"
@@ -258,6 +274,7 @@ def analyze(
                     "data_format": row["data_format"],
                     "chaos": row["chaos"],
                     "coloc": row["coloc"],
+                    "pools": row["pools"],
                 }
         rows.append(row)
     return {

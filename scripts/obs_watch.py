@@ -174,6 +174,30 @@ def render(snapshot: dict) -> str:
         add("fleet health: " + "  ".join(
             f"{label} {v:.0f}" for label, v in health
         ))
+    # Disaggregation row (docs/SERVING.md): the live prefill/decode
+    # pool split plus the handoff seam and directory-hit counters.
+    # Absent on colocated fleets, which emit none of these.
+    disagg = []
+    for label, name in (
+        ("prefill", "fleet.prefill_replicas"),
+        ("decode", "fleet.decode_replicas"),
+        ("handoff ms", "serve.handoff_ms"),
+    ):
+        cell = (gauges or {}).get(name)
+        if cell is not None and cell.get("value") is not None:
+            disagg.append((label, cell["value"]))
+    if disagg:
+        for label, name in (
+            ("directory hits", "serve.directory_hits"),
+            ("migrations", "serve.migrations"),
+        ):
+            cell = counters.get(name)
+            if cell and cell.get("sum"):
+                disagg.append((label, cell["sum"]))
+        add("")
+        add("disaggregation: " + "  ".join(
+            f"{label} {_fmt_val(v)}" for label, v in disagg
+        ))
     # Pool-ownership row (train/serve colocation, serving/arbiter.py +
     # docs/ROBUSTNESS.md colocation): who holds the ONE device pool
     # right now — training's world size vs the replicas serving holds

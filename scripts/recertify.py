@@ -29,6 +29,11 @@ Protocols (all via bench.py's existing modes — no new measurement code):
                     replicas, multi-tenant closed
                     backlog: scaling + flat TTFT +
                     weighted fairness + bitwise parity
+    serve_lm_disagg disagg_bench split prefill/decode  tokens/sec
+                    pools vs colocated at equal
+                    replica count: TTFT win, parity,
+                    prefill-once directory, live
+                    migration, closed sets per pool
     serve_lm_chaos  chaos_bench seeded mixed-verb      tokens/sec
                     fault storm (crash/hang/slow/
                     corrupt/flap) + brownout ladder:
@@ -179,6 +184,26 @@ PROTOCOLS = {
         "SERVE_RATE_RPS": "0", "SERVE_BUCKETS": "8,16",
         "SERVE_CHAOS_SEED": "0",
     },
+    # Disaggregation tier (docs/SERVING.md disaggregation): the same
+    # bimodal hot-prefix backlog served by a colocated fleet and by the
+    # SAME replica count split into prefill/decode pools with the
+    # fleet-wide prefix directory on — the row's JSON line carries both
+    # runs, the p99-TTFT speedup, the handoff/migration/directory
+    # ledgers and every gate verdict, and the script exits non-zero
+    # unless disagg p99 TTFT strictly beats coloc, inter-token p99
+    # stays inside its factor, every stream is bitwise equal to
+    # sequential generate, the directory probe re-serves a shared
+    # prompt with ZERO fleet-wide prefill executions, one scheduled
+    # live migration lands with zero drops, and program sets stay
+    # closed per pool.
+    "serve_lm_disagg": {
+        "_script": "scripts/disagg_bench.py",
+        "BENCH_MODEL": "lm_tiny", "BENCH_VOCAB": "32000",
+        "SERVE_REPLICAS": "4", "SERVE_SLOTS": "4",
+        "SERVE_TENANT_WEIGHTS": "alpha:1,beta:1",
+        "SERVE_REQUESTS": "24", "SERVE_RATE_RPS": "0",
+        "SERVE_PROFILE": "disagg", "SERVE_SEED": "0",
+    },
     # Colocation tier (docs/ROBUSTNESS.md colocation): ONE device pool
     # shared by training and serving under a combined fault+chaos storm
     # — a serving surge drives the brownout ladder to exhaustion, the
@@ -261,6 +286,13 @@ _PROTOCOL_VARS = (
     "SERVE_QUARANTINE_TICKS", "SERVE_PUMP_HEARTBEAT_S",
     "SERVE_REPLICA_MAX_RESTARTS", "SERVE_REPLICA_RESTART_BACKOFF",
     "SERVE_FAULT_JOIN_S", "SERVE_BROWNOUT_STAGES",
+    # Disaggregation plane (serve_lm_disagg row, docs/SERVING.md): a
+    # leaked SERVE_DISAGG (or pool split / bench tuning) must never
+    # split the other serving rows' fleets or reshape the disagg gates.
+    "SERVE_DISAGG", "SERVE_POOL_PREFILL", "SERVE_POOL_DECODE",
+    "SERVE_DISAGG_DIRECTORY", "SERVE_DISAGG_PREFETCH",
+    "BENCH_DISAGG_PREFIX_LEN", "BENCH_DISAGG_ITL_FACTOR",
+    "BENCH_DISAGG_MIGRATE_TICK",
     # Streamed data plane (lm_stream row + the DATA_* data-factory
     # knobs, docs/DATA.md): joined here so an exported DATA_FORMAT or
     # stream geometry can never leak into rows that leave it unset.

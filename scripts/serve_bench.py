@@ -56,7 +56,7 @@ Env knobs (defaults in parentheses): ``SERVE_SLOTS`` (8),
 ``SERVE_BUCKETS`` ("8,16"; compare/longtail default covers the long
 tail), ``SERVE_REQUESTS`` (32), ``SERVE_MAX_NEW`` (16),
 ``SERVE_RATE_RPS`` (200 — Poisson arrival rate; 0 = closed backlog,
-all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail),
+all at t=0), ``SERVE_SEED`` (0), ``SERVE_PROFILE`` (mixed | longtail | disagg),
 ``SERVE_KV_LAYOUT`` (dense | paged | compare), ``SERVE_BLOCK_SIZE``
 (16), ``SERVE_NUM_BLOCKS`` (0 = dense-equivalent),
 ``SERVE_POOL_SLOT_BUDGET`` (4 — the fixed byte budget, in dense slots),

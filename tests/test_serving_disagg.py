@@ -1,0 +1,575 @@
+"""Disaggregated prefill/decode serving oracles (docs/SERVING.md).
+
+The disaggregation tier's claims, each pinned here:
+
+1. **Handoff bitwise parity** — a prefill-pool replica prefills, the
+   router hands the exported block table to a decode-pool replica, and
+   the delivered stream is bitwise the sequential ``generate``
+   reference; prefill programs never run on decode replicas and every
+   engine's program set stays closed.
+2. **Fleet-wide prefix directory** — a greedy export publishes its
+   prompt; an identical later prompt is ADOPTED (state transplant,
+   zero additional prefill-program executions anywhere in the fleet),
+   and every ``(rid, bid)`` the directory maps is pinned + resident on
+   that replica (the LRU can never evict a directory-mapped block).
+3. **Live KV-block migration** — ``Router.migrate`` moves a running
+   stream between decode replicas as a state transplant: zero drops,
+   bitwise splice, ``serve.migrations`` accounted.
+4. **Ledger balance under churn** — cancel-mid-handoff and a prefill
+   replica dying mid-handoff leak nothing: after the storm drains and
+   the directory releases its pins, every live allocator is back to
+   ``live_count == 0`` and ``free_count == capacity``.
+5. **Per-pool autoscale** — ``ControllerConfig.pools`` scales the hot
+   pool with ``factory(rid, pool)`` and drains the cold one without
+   touching its sibling.
+
+Engines are tiny (64-vocab lm) and replicas are pumped inline
+(threaded=False): every step of every pump happens on the test thread.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distributeddeeplearning_tpu.inference import generate  # noqa: E402
+from distributeddeeplearning_tpu.models.transformer_lm import (  # noqa: E402
+    TransformerLM,
+)
+from distributeddeeplearning_tpu.serving import (  # noqa: E402
+    BlockAllocator,
+    BlockPoolExhausted,
+    ControllerConfig,
+    FleetConfig,
+    FleetController,
+    PrefixDirectory,
+    Replica,
+    Request,
+    Router,
+    ServeConfig,
+)
+from distributeddeeplearning_tpu.serving.fleet import (  # noqa: E402
+    PoolWatermarks,
+)
+
+VOCAB, MAX_LEN, BLOCK = 64, 32, 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=MAX_LEN,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import flax.linen as nn
+
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, MAX_LEN), jnp.int32),
+        train=False,
+    )
+    return nn.unbox(variables["params"])
+
+
+def _scfg(**over):
+    kw = dict(
+        num_slots=2, buckets=(8,), prefills_per_step=2,
+        kv_layout="paged", block_size=BLOCK,
+    )
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def _prompt(rng, n=8):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _ref_new(model, params, prompt, max_new):
+    """Greedy reference NEW tokens for ``prompt`` (the oracle every
+    disagg path must match bitwise)."""
+    out = np.asarray(generate(
+        model, params, np.asarray(prompt)[None],
+        max_new_tokens=max_new, temperature=0.0,
+    ))[0]
+    return [int(t) for t in out[len(prompt):]]
+
+
+def _pump(router, until, limit=6000):
+    """Step the router until ``until()`` or idle; bounded."""
+    for _ in range(limit):
+        if until():
+            return True
+        if not router.step():
+            break
+    return until()
+
+
+def _ledger_balanced(replica):
+    """Allocator back to rest: nothing referenced, everything
+    allocatable (free list + unpinned evictable cache)."""
+    a = replica.engine.allocator
+    return a.live_count == 0 and a.free_count == a.capacity
+
+
+def _release_directory(router):
+    """Teardown half of the directory contract: drop every entry and
+    unpin the returned mappings on their (live) replicas."""
+    if router.directory is None:
+        return
+    by_rid = {r.rid: r for r in router.replicas}
+    for rid, bids in router.directory.clear():
+        r = by_rid.get(rid)
+        if r is None or r.engine is None or r.engine.allocator is None:
+            continue
+        for bid in bids:
+            r.engine.allocator.unpin(bid)
+
+
+# -- directory unit oracles (pure host, no engine) -----------------------
+
+
+def _payload(n_blocks, fill=0.5):
+    return {("layer", "k"): np.full(
+        (n_blocks, BLOCK, 2), fill, np.float32
+    )}
+
+
+def test_directory_publish_lookup_adopt():
+    d = PrefixDirectory()
+    p = np.arange(8, dtype=np.int32)
+    assert d.lookup(p) is None and d.stats["hits"] == 0
+    assert d.publish(
+        0, p, [3, 7], _payload(2), first_token=5, block_size=BLOCK
+    )
+    # Same holder republishing is a no-op (caller unpins); a second
+    # replica becomes an additional holder of the same entry.
+    assert not d.publish(
+        0, p, [3, 7], _payload(2), first_token=5, block_size=BLOCK
+    )
+    assert d.publish(
+        1, p, [9], _payload(1), first_token=5, block_size=BLOCK
+    )
+    ent = d.lookup(p)
+    assert ent is not None and ent["owner"] == 0
+    assert ent["holders"] == {0: [3, 7], 1: [9]}
+    assert ent["first_token"] == 5 and ent["adoptions"] == 0
+    assert d.adopt(p)["adoptions"] == 1
+    assert len(d) == 1
+    assert d.stats["lookups"] == 3 and d.stats["hits"] == 2
+    assert sorted(d.mapped_blocks(0)) == [3, 7]
+
+
+def test_directory_chain_lookup_and_drop_replica():
+    d = PrefixDirectory()
+    p = np.arange(8, dtype=np.int32)
+    d.publish(0, p, [3, 7], _payload(2), first_token=5, block_size=BLOCK)
+    # A longer prompt sharing the first full block chain-hits; the
+    # payload slice covers exactly the matched rows.
+    longer = np.concatenate([p[:4], np.full(4, 63, np.int32)])
+    n, ent, sliced = d.lookup_chain(longer, BLOCK)
+    assert n == 1 and ent is not None
+    assert sliced[("layer", "k")].shape[0] == 1
+    # Block-size mismatch is a miss, never a wrong-shaped hit.
+    assert d.lookup_chain(p, BLOCK * 2) == (0, None, {})
+    # Owner death re-homes to a surviving holder ...
+    d.publish(1, p, [9], _payload(1), first_token=5, block_size=BLOCK)
+    unmapped = d.drop_replica(0)
+    assert unmapped == [(0, [3, 7])]
+    assert d.lookup(p)["owner"] == 1 and d.stats["rehomed"] == 1
+    # ... and the last holder's death drops the entry and its chains.
+    d.drop_replica(1)
+    assert len(d) == 0 and d.lookup(p) is None
+    assert d.lookup_chain(longer, BLOCK) == (0, None, {})
+    assert d.stats["dropped"] == 1
+
+
+def test_directory_clear_returns_every_mapping():
+    d = PrefixDirectory()
+    a = np.arange(8, dtype=np.int32)
+    b = np.arange(8, 16, dtype=np.int32)
+    d.publish(0, a, [1, 2], _payload(2), first_token=0, block_size=BLOCK)
+    d.publish(1, b, [4], _payload(1), first_token=0, block_size=BLOCK)
+    got = sorted(d.clear())
+    assert got == [(0, [1, 2]), (1, [4])]
+    assert len(d) == 0 and d.lookup(a) is None
+
+
+def test_allocator_pins_block_eviction_and_recycling():
+    a = BlockAllocator(num_blocks=6, block_size=BLOCK)  # 5 usable
+    bids = a.alloc(2)
+    with pytest.raises(KeyError):
+        a.pin(999)  # not resident anywhere
+    a.pin(bids[0])
+    for bid in bids:
+        a.decref(bid)
+    # The pinned (unregistered) block stays resident instead of
+    # returning to the free list, and is excluded from free capacity.
+    assert a.pinned(bids[0]) and a.free_count == a.capacity - 1
+    with pytest.raises(BlockPoolExhausted):
+        a.alloc(a.capacity)
+    # A pinned *registered* block survives eviction pressure: filling
+    # the pool evicts every other cached block but never the pin.
+    toks = np.arange(BLOCK, dtype=np.int32)
+    reg = a.alloc(1)
+    a.register_prefix(toks, reg)
+    a.pin(reg[0])
+    a.decref(reg[0])
+    grab = a.alloc(a.free_count)
+    assert a.pinned(reg[0]) and a.peek_prefix(toks, BLOCK) == 1
+    for bid in grab:
+        a.decref(bid)
+    # Unpin releases both: the registered block becomes evictable, the
+    # unregistered one returns to the free list; ledger balances.
+    a.unpin(bids[0])
+    a.unpin(reg[0])
+    assert a.live_count == 0 and a.free_count == a.capacity
+
+
+def test_prefix_reuse_never_windows_past_position_capacity():
+    """A cached-prefix hit shifts the suffix prefill's bucket window to
+    [start, start + bucket); past the position-embedding capacity the
+    padded tail's rows gather as NaN fill, the NaN K/V lands in the
+    trash block, and zero-weight × NaN poisons EVERY slot's attention
+    (the disagg bench's 96-token prompt over a 32-token hot prefix
+    found this — all-zero argmax streams). The engine must shrink the
+    match until the window fits — bitwise parity over reuse depth."""
+    import flax.linen as nn
+
+    cap = 10  # == engine max_len: bucket windows past 10 have no rows
+    m = TransformerLM(
+        variant="tiny", vocab_size=VOCAB, max_seq_len=cap,
+        dtype=jnp.float32,
+    )
+    p10 = nn.unbox(m.init(
+        jax.random.PRNGKey(2), jnp.zeros((2, cap), jnp.int32),
+        train=False,
+    )["params"])
+    router = Router(config=FleetConfig(replicas=1))
+    # An 8-token prompt with a 1-block hit would window [4, 12) in the
+    # (8,) bucket — two rows past capacity — unless the match shrinks.
+    router.add_replica(
+        Replica(0, m, p10, _scfg(), max_len=cap, pool="mixed"),
+        start=True, threaded=False,
+    )
+    _pump(router, lambda: all(r.state == "ready" for r in router.replicas))
+    rng = np.random.RandomState(23)
+    a = _prompt(rng, 8)
+    b = np.concatenate([a[:BLOCK], _prompt(rng, 4)]).astype(np.int32)
+    try:
+        for p in (a, b):
+            fh = router.submit(Request(
+                prompt=p, max_new_tokens=2, temperature=0.0,
+            ))
+            assert _pump(router, lambda: fh.done.is_set())
+            assert [int(t) for t in fh.new_tokens] == _ref_new(
+                m, p10, p, 2
+            )
+    finally:
+        router.close()
+
+
+# -- fleet config --------------------------------------------------------
+
+
+def test_fleet_config_disagg_env_and_pool_split():
+    cfg = FleetConfig.from_env({
+        "SERVE_REPLICAS": "4", "SERVE_DISAGG": "1",
+        "SERVE_POOL_PREFILL": "1", "SERVE_DISAGG_DIRECTORY": "0",
+    })
+    assert cfg.disagg and not cfg.directory
+    assert cfg.pool_split() == (1, 3)
+    assert FleetConfig(replicas=4, disagg=True).pool_split() == (2, 2)
+    assert FleetConfig(replicas=5, disagg=True).pool_split() == (2, 3)
+    assert FleetConfig(
+        replicas=5, disagg=True, decode_pool=4
+    ).pool_split() == (1, 4)
+    # Colocated fleets have no pools at all.
+    assert FleetConfig(replicas=4).pool_split() == (0, 0)
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=1, disagg=True).validate()
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=3, disagg=True, prefill_pool=3).validate()
+
+
+# -- disaggregated fleet (1 prefill + 2 decode, inline) ------------------
+
+
+@pytest.fixture(scope="module")
+def dfleet(model, params):
+    """One long-lived disaggregated fleet shared by the non-destructive
+    tests below (engine compiles amortized module-wide). The directory
+    is the router's, so entries accumulate across tests — each test
+    uses fresh prompts unless reuse is the point."""
+    pools = ("prefill", "decode", "decode")
+    reps = [
+        Replica(
+            k, model, params, _scfg(), max_len=MAX_LEN, pool=pools[k]
+        ).start(threaded=False)
+        for k in range(3)
+    ]
+    router = Router(config=FleetConfig(
+        replicas=3, disagg=True, prefill_pool=1, decode_pool=2,
+    ))
+    for r in reps:
+        router.add_replica(r, start=False)
+    assert router.directory is not None
+    yield router
+    router.close()
+
+
+def test_handoff_bitwise_parity_and_closed_pools(dfleet, model, params):
+    rng = np.random.RandomState(7)
+    cases = []
+    for i in range(6):
+        p = _prompt(rng, n=4 + (i % 5))
+        cases.append((p, 4 + (i % 4), dfleet.submit(Request(
+            prompt=p, max_new_tokens=4 + (i % 4), temperature=0.0,
+        ))))
+    handles = [fh for _, _, fh in cases]
+    assert _pump(dfleet, lambda: all(h.done.is_set() for h in handles))
+    for p, n, fh in cases:
+        assert fh.finish_reason in ("eos", "length")
+        assert fh.new_tokens == _ref_new(model, params, p, n)[
+            : len(fh.new_tokens)
+        ]
+        assert fh.restart_consistent
+        # Decode happened on the decode pool, not where prefill ran.
+        assert dfleet._replica(fh.replica_id).pool == "decode"
+    assert dfleet.stats["handoffs"] >= 6
+    pre, dec = dfleet._replica(0), dfleet.replicas[1:]
+    assert pre.pool == "prefill" and pre.engine.prefill_execs >= 6
+    for r in dec:
+        # Prefill-once is structural: decode replicas run NO prefill
+        # programs, ever — work arrives only as imported block tables.
+        assert r.engine.prefill_execs == 0
+    for r in dfleet.replicas:
+        assert r.engine.compile_count == r.engine.programs_expected, (
+            f"replica {r.rid} ({r.pool}) program set not closed"
+        )
+
+
+def test_directory_adoption_runs_zero_prefill(dfleet, model, params):
+    rng = np.random.RandomState(11)
+    hot = _prompt(rng, n=8)  # two full blocks: publishable + pinnable
+    first = dfleet.submit(Request(
+        prompt=hot, max_new_tokens=6, temperature=0.0,
+    ))
+    assert _pump(dfleet, first.done.is_set)
+    assert first.new_tokens == _ref_new(model, params, hot, 6)
+    assert dfleet.directory.lookup(hot.copy()) is not None
+    execs_pre = sum(r.engine.prefill_execs for r in dfleet.replicas)
+    hits_pre = dfleet.stats["directory_hits"]
+    second = dfleet.submit(Request(
+        prompt=hot, max_new_tokens=6, temperature=0.0,
+    ))
+    assert _pump(dfleet, second.done.is_set)
+    assert second.new_tokens == first.new_tokens
+    assert sum(
+        r.engine.prefill_execs for r in dfleet.replicas
+    ) == execs_pre, "adoption must not run any prefill program"
+    assert dfleet.stats["directory_hits"] > hits_pre
+    assert dfleet.directory.lookup(hot)["adoptions"] >= 1
+
+
+def test_directory_mapped_blocks_are_pinned_and_resident(dfleet):
+    mapped_total = 0
+    for r in dfleet.replicas:
+        a = r.engine.allocator
+        for bid in dfleet.directory.mapped_blocks(r.rid):
+            mapped_total += 1
+            assert a.pinned(bid), f"mapped block {bid} unpinned on {r.rid}"
+            assert bid in a._ref or bid in a._lru, (
+                f"mapped block {bid} not resident on {r.rid}"
+            )
+    assert mapped_total >= 1, "no publish pinned anything"
+
+
+def test_live_migration_zero_drop_bitwise(dfleet, model, params):
+    rng = np.random.RandomState(13)
+    p = _prompt(rng, n=6)
+    fh = dfleet.submit(Request(
+        prompt=p, max_new_tokens=12, temperature=0.0,
+    ))
+    assert _pump(dfleet, lambda: (
+        len(fh.new_tokens) >= 3 and fh.status == "running"
+        and fh.replica_id is not None
+        and dfleet._replica(fh.replica_id).pool == "decode"
+    ))
+    src = fh.replica_id
+    migs_pre = dfleet.stats["migrations"]
+    moved = dfleet.migrate(src)
+    assert moved == 1, "sibling decode replica had room: expected transplant"
+    assert dfleet.stats["migrations"] == migs_pre + 1
+    assert fh.replica_id != src and fh.status == "running"
+    assert _pump(dfleet, fh.done.is_set)
+    assert fh.new_tokens == _ref_new(model, params, p, 12)
+    # prefill dispatch + handoff attach + migration attach
+    assert fh.restart_consistent and fh.attempts == 3
+
+
+def test_pool_pressure_signals(dfleet):
+    assert dfleet.pool_pressure("prefill") >= 0.0
+    assert dfleet.pool_pressure("decode") >= 0.0
+
+
+# -- churn: cancel + prefill death mid-handoff (dedicated fleets) --------
+
+
+def test_cancel_mid_handoff_leaks_nothing(model, params):
+    """A parked export (decode pool full) that gets cancelled is
+    dropped by the handoff sweep with terminal accounting and zero
+    block leakage on either side."""
+    reps = [
+        Replica(0, model, params, _scfg(num_slots=1), max_len=MAX_LEN,
+                pool="prefill").start(threaded=False),
+        Replica(1, model, params, _scfg(num_slots=1), max_len=MAX_LEN,
+                pool="decode").start(threaded=False),
+    ]
+    router = Router(config=FleetConfig(
+        replicas=2, disagg=True, prefill_pool=1, decode_pool=1,
+    ))
+    for r in reps:
+        router.add_replica(r, start=False)
+    rng = np.random.RandomState(17)
+    pa, pb = _prompt(rng, n=8), _prompt(rng, n=8)
+    fa = router.submit(Request(
+        prompt=pa, max_new_tokens=10, temperature=0.0,
+    ))
+    # Seat A on the (only) decode slot first.
+    assert _pump(router, lambda: (
+        fa.status == "running" and fa.replica_id == 1
+    ))
+    fb = router.submit(Request(
+        prompt=pb, max_new_tokens=6, temperature=0.0,
+    ))
+    # B prefills, exports, and parks: the decode pool has no room.
+    assert _pump(router, lambda: len(router._pending_handoffs) == 1)
+    cancelled_pre = router.stats["cancelled"]
+    fb.cancel()
+    assert _pump(router, fb.done.is_set)
+    assert fb.finish_reason == "cancelled"
+    assert router.stats["cancelled"] == cancelled_pre + 1
+    assert not router._pending_handoffs
+    # A is untouched by the drop and finishes bitwise.
+    assert _pump(router, fa.done.is_set)
+    assert fa.new_tokens == _ref_new(model, params, pa, 10)
+    # Ledger parity: directory pins released -> both allocators at rest.
+    _release_directory(router)
+    for r in reps:
+        assert _ledger_balanced(r), f"replica {r.rid} leaked blocks"
+    router.close()
+
+
+def test_prefill_death_mid_handoff_is_lossless(model, params):
+    """Kill one of two prefill replicas mid-storm: collected exports
+    outlive their producer (host data), running prefills replay on the
+    survivor, every stream completes bitwise, and the survivors'
+    ledgers balance after the directory releases its pins."""
+    pools = ("prefill", "prefill", "decode")
+    reps = [
+        Replica(k, model, params, _scfg(), max_len=MAX_LEN,
+                pool=pools[k]).start(threaded=False)
+        for k in range(3)
+    ]
+    router = Router(config=FleetConfig(
+        replicas=3, disagg=True, prefill_pool=2, decode_pool=1,
+    ))
+    for r in reps:
+        router.add_replica(r, start=False)
+    rng = np.random.RandomState(19)
+    cases = []
+    for i in range(8):
+        p = _prompt(rng, n=4 + (i % 5))
+        cases.append((p, 3 + (i % 4), router.submit(Request(
+            prompt=p, max_new_tokens=3 + (i % 4), temperature=0.0,
+        ))))
+    for _ in range(2):
+        router.step()
+    router.fail_replica(0, error=RuntimeError("chaos: pump died"))
+    assert not router.directory.mapped_blocks(0), (
+        "directory must never map blocks on a dead replica"
+    )
+    handles = [fh for _, _, fh in cases]
+    assert _pump(router, lambda: all(h.done.is_set() for h in handles))
+    for p, n, fh in cases:
+        assert fh.finish_reason in ("eos", "length")
+        assert fh.new_tokens == _ref_new(model, params, p, n)[
+            : len(fh.new_tokens)
+        ]
+        assert fh.restart_consistent, f"request {fh.id} splice diverged"
+    _release_directory(router)
+    for r in reps[1:]:  # replica 0 is dead; its engine is not trusted
+        assert _ledger_balanced(r), f"replica {r.rid} leaked blocks"
+    router.close()
+
+
+# -- per-pool autoscale ---------------------------------------------------
+
+
+def test_controller_per_pool_watermarks(model, params):
+    """A prefill burst scales the prefill pool (factory told which
+    pool to build for) and a later prefill lull drains it — the decode
+    pool's replica count never moves."""
+    reps = [
+        Replica(0, model, params, _scfg(), max_len=MAX_LEN,
+                pool="prefill").start(threaded=False),
+        Replica(1, model, params, _scfg(), max_len=MAX_LEN,
+                pool="decode").start(threaded=False),
+    ]
+    router = Router(config=FleetConfig(
+        replicas=2, disagg=True, prefill_pool=1, decode_pool=1,
+    ))
+    for r in reps:
+        router.add_replica(r, start=False)
+    built = []
+
+    def factory(rid, pool):
+        built.append((rid, pool))
+        return Replica(rid, model, params, _scfg(), max_len=MAX_LEN,
+                       pool=pool)
+
+    pressures = {"prefill": 2.0, "decode": 0.5}
+    wm = dict(high_pressure=1.0, low_pressure=0.3, up_ticks=2,
+              down_ticks=2)
+    ctl = FleetController(
+        router, factory,
+        ControllerConfig(pools={
+            "prefill": PoolWatermarks(min_replicas=1, max_replicas=2,
+                                      **wm),
+            "decode": PoolWatermarks(min_replicas=1, max_replicas=1,
+                                     **wm),
+        }),
+        reader=lambda pool=None: pressures.get(pool),
+        threaded_replicas=False,
+    )
+    assert ctl.tick() is None          # prefill hot streak 1
+    assert ctl.tick() == "scale_up"    # streak 2 -> grow prefill pool
+    assert built == [(2, "prefill")]
+    assert router._replica(2).pool == "prefill"
+    def count(pool):
+        return sum(1 for r in router.replicas
+                   if r.pool == pool and r.state in ("starting", "ready"))
+    assert count("prefill") == 2 and count("decode") == 1
+    pressures["prefill"] = 0.1         # the burst ends
+    assert ctl.tick() is None          # cold streak 1
+    assert ctl.tick() == "drain"       # streak 2 -> drain a prefill
+    assert _pump(router, lambda: any(
+        r.state == "drained" for r in router.replicas
+    ), limit=200)
+    assert ctl.tick() == "remove"
+    assert count("prefill") == 1 and count("decode") == 1
+    pool_actions = [a for a in ctl.actions if "pool" in a]
+    assert pool_actions and all(
+        a["pool"] == "prefill" for a in pool_actions
+    ), f"decode pool was touched: {ctl.actions}"
+    # Without an injected reader, per-pool reads route to the router's
+    # pool_pressure signal.
+    ctl2 = FleetController(router, factory)
+    assert ctl2.read_pressure("decode") == pytest.approx(
+        router.pool_pressure("decode")
+    )
+    router.close()
